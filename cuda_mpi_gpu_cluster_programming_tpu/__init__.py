@@ -1,0 +1,25 @@
+"""TPU-native staged-parallelism framework.
+
+A brand-new JAX/XLA/Pallas/shard_map framework with the capabilities of the
+reference CUDA+MPI repo (`mykolas-perevicius/CUDA-MPI-GPU-Cluster-Programming`):
+a staged parallelization study of AlexNet Blocks 1-2 inference, where the
+reference's five divergent code copies (V1 serial, V2.1 broadcast-all,
+V2.2 scatter+halo, V3 CUDA, V4 MPI+CUDA) become *execution configs of one
+codebase*:
+
+- ``ops.reference``  — pure jax.numpy/XLA op tier (the "V1" semantics,
+  jit-compiled; reference: v1_serial/src/layers_serial.cpp:37-175).
+- ``ops.pallas_kernels`` — hand-written Pallas TPU kernels (the "V3" tier;
+  reference: v3_cuda_only/src/layers_cuda.cu:20-152).
+- ``parallel`` — 1-D mesh row decomposition with ppermute halo exchange over
+  ICI (the "V2.2/V4/V5" tier; reference: v2_mpi_only/2.2_scatter_halo/src/
+  main.cpp:100-249 and v4_mpi_cuda/src/alexnet_mpi_cuda.cu:27-154), with
+  exact per-shard output-row ownership replacing the reference's buggy
+  compute-then-trim heuristic (v4_mpi_cuda/src/main_mpi_cuda.cpp:102-119).
+- ``models.alexnet`` — the single model definition all tiers share.
+- ``utils`` / ``analysis`` — bench harness (CSV schema, ASCII table, env
+  triage) and the DuckDB/sqlite speedup-efficiency ETL (reference:
+  scripts/common_test_utils.sh, log_analysis.py).
+"""
+
+__version__ = "0.1.0"

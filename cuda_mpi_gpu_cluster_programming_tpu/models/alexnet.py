@@ -1,0 +1,113 @@
+"""AlexNet Blocks 1-2: the single model definition shared by every tier.
+
+The reference maintains five divergent copies of this network (one per
+parallelization stage); here there is exactly one functional definition and
+the stages are execution configs. Hyperparameters default to the reference's
+hard-coded values (v1_serial/src/main.cpp:21-43,
+v2_mpi_only/2.2_scatter_halo/src/main.cpp:35-47):
+
+    227x227x3 -Conv1(K=96,F=11,S=4,P=0)-> 55x55x96 -Pool1(3,2)-> 27x27x96
+             -Conv2(K=256,F=5,S=1,P=2)-> 27x27x256 -Pool2(3,2)-> 13x13x256
+             -LRN2(N=5, a=1e-4, b=0.75, k=2.0)-> 13x13x256
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+
+from ..ops import reference as ops
+from ..ops.shapes import conv_out_dim, pool_out_dim
+
+Params = Dict[str, Dict[str, Any]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvSpec:
+    out_channels: int
+    filter_size: int
+    stride: int
+    padding: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSpec:
+    window: int
+    stride: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LrnSpec:
+    size: int
+    alpha: float
+    beta: float
+    k: float
+    # False = the reference's CUDA form (k + alpha*sum — the headline golden
+    # numbers); True = its CPU form (k + alpha*sum/size). See ops.reference.lrn.
+    alpha_over_size: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Blocks12Config:
+    """AlexNet Blocks 1-2 hyperparameters (reference defaults)."""
+
+    in_height: int = 227
+    in_width: int = 227
+    in_channels: int = 3
+    conv1: ConvSpec = ConvSpec(96, 11, 4, 0)
+    pool1: PoolSpec = PoolSpec(3, 2)
+    conv2: ConvSpec = ConvSpec(256, 5, 1, 2)
+    pool2: PoolSpec = PoolSpec(3, 2)
+    lrn2: LrnSpec = LrnSpec(5, 1e-4, 0.75, 2.0)
+
+    def layer_chain(self) -> Tuple[Tuple[str, Any], ...]:
+        """The spatial layer sequence (used by the shard planner)."""
+        return (
+            ("conv1", self.conv1),
+            ("pool1", self.pool1),
+            ("conv2", self.conv2),
+            ("pool2", self.pool2),
+            ("lrn2", self.lrn2),
+        )
+
+
+BLOCKS12 = Blocks12Config()
+
+
+def output_shape(cfg: Blocks12Config = BLOCKS12) -> Tuple[int, int, int]:
+    """(H, W, C) of the final output — 13x13x256 for the defaults.
+
+    Mirrors the dim chain at v2_mpi_only/2.2_scatter_halo/src/main.cpp:49-58.
+    """
+    h, w = cfg.in_height, cfg.in_width
+    h = conv_out_dim(h, cfg.conv1.filter_size, cfg.conv1.padding, cfg.conv1.stride)
+    w = conv_out_dim(w, cfg.conv1.filter_size, cfg.conv1.padding, cfg.conv1.stride)
+    h = pool_out_dim(h, cfg.pool1.window, cfg.pool1.stride)
+    w = pool_out_dim(w, cfg.pool1.window, cfg.pool1.stride)
+    h = conv_out_dim(h, cfg.conv2.filter_size, cfg.conv2.padding, cfg.conv2.stride)
+    w = conv_out_dim(w, cfg.conv2.filter_size, cfg.conv2.padding, cfg.conv2.stride)
+    h = pool_out_dim(h, cfg.pool2.window, cfg.pool2.stride)
+    w = pool_out_dim(w, cfg.pool2.window, cfg.pool2.stride)
+    return h, w, cfg.conv2.out_channels
+
+
+def forward_blocks12(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
+    """Forward pass Conv1→ReLU→Pool1→Conv2→ReLU→Pool2→LRN2.
+
+    Functional replacement for the reference's ping-pong double-buffer
+    orchestrator (v1_serial/src/alexnet_serial.cpp:67-186). ``x`` is NHWC;
+    params is ``{"conv1": {"w","b"}, "conv2": {"w","b"}}`` with HWIO weights.
+    """
+    c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
+    x = ops.conv2d(x, params["conv1"]["w"], params["conv1"]["b"], stride=c1.stride, padding=c1.padding)
+    x = ops.relu(x)
+    x = ops.maxpool(x, window=p1.window, stride=p1.stride)
+    x = ops.conv2d(x, params["conv2"]["w"], params["conv2"]["b"], stride=c2.stride, padding=c2.padding)
+    x = ops.relu(x)
+    x = ops.maxpool(x, window=p2.window, stride=p2.stride)
+    x = ops.lrn(
+        x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
+    )
+    return x

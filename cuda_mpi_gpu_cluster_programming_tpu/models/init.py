@@ -1,0 +1,82 @@
+"""Parameter and input initialization + reference-layout converters.
+
+Two init modes, matching the reference:
+
+- deterministic: input = 1.0, weights = 0.01, biases = 0.0 — the mode V2.1,
+  V2.2, V3 and V4 all use so their outputs are cross-comparable
+  (2.2_scatter_halo/src/main.cpp:37-47, v3_cuda_only/src/main_cuda.cpp:16-27,
+  v4_mpi_cuda/src/main_mpi_cuda.cpp:29-33).
+- random: uniform [0,1) data/weights, bias = 0.1 — V1's mode
+  (v1_serial/src/alexnet_serial.cpp:39-57), except the reference seeds with
+  ``srand(time(0))`` (v1_serial/src/main.cpp:12) making V1 non-comparable
+  across runs; here randomness is always explicitly keyed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .alexnet import BLOCKS12, Blocks12Config
+
+Params = Dict[str, Dict[str, Any]]
+
+
+def _conv_shapes(cfg: Blocks12Config):
+    c1, c2 = cfg.conv1, cfg.conv2
+    w1 = (c1.filter_size, c1.filter_size, cfg.in_channels, c1.out_channels)
+    w2 = (c2.filter_size, c2.filter_size, c1.out_channels, c2.out_channels)
+    return w1, (c1.out_channels,), w2, (c2.out_channels,)
+
+
+def init_params_deterministic(cfg: Blocks12Config = BLOCKS12, dtype=jnp.float32) -> Params:
+    """weights = 0.01, biases = 0.0 (cross-version comparison oracle init)."""
+    w1s, b1s, w2s, b2s = _conv_shapes(cfg)
+    return {
+        "conv1": {"w": jnp.full(w1s, 0.01, dtype), "b": jnp.zeros(b1s, dtype)},
+        "conv2": {"w": jnp.full(w2s, 0.01, dtype), "b": jnp.zeros(b2s, dtype)},
+    }
+
+
+def init_params_random(key: jax.Array, cfg: Blocks12Config = BLOCKS12, dtype=jnp.float32) -> Params:
+    """Uniform [0,1) weights, bias 0.1 — V1 semantics but reproducibly keyed."""
+    k1, k2 = jax.random.split(key)
+    w1s, b1s, w2s, b2s = _conv_shapes(cfg)
+    return {
+        "conv1": {
+            "w": jax.random.uniform(k1, w1s, dtype),
+            "b": jnp.full(b1s, 0.1, dtype),
+        },
+        "conv2": {
+            "w": jax.random.uniform(k2, w2s, dtype),
+            "b": jnp.full(b2s, 0.1, dtype),
+        },
+    }
+
+
+def deterministic_input(batch: int = 1, cfg: Blocks12Config = BLOCKS12, dtype=jnp.float32) -> jax.Array:
+    """All-ones NHWC input (2.2_scatter_halo/src/main.cpp:37)."""
+    return jnp.ones((batch, cfg.in_height, cfg.in_width, cfg.in_channels), dtype)
+
+
+def random_input(key: jax.Array, batch: int = 1, cfg: Blocks12Config = BLOCKS12, dtype=jnp.float32) -> jax.Array:
+    """Uniform [0,1) NHWC input (v1_serial/src/alexnet_serial.cpp:39-43, keyed)."""
+    return jax.random.uniform(key, (batch, cfg.in_height, cfg.in_width, cfg.in_channels), dtype)
+
+
+def to_reference_layout(w: jax.Array) -> np.ndarray:
+    """HWIO ``(F,F,C,K)`` → the reference's flat K,C,F,F weight layout.
+
+    ``w_idx = ((k*C + c)*F + fy)*F + fx`` (v1_serial/src/layers_serial.cpp:70,
+    v3_cuda_only/src/layers_cuda.cu:41).
+    """
+    return np.asarray(w).transpose(3, 2, 0, 1).reshape(-1)
+
+
+def from_reference_layout(flat, f: int, c: int, k: int) -> jnp.ndarray:
+    """Flat K,C,F,F reference weights → HWIO ``(F,F,C,K)``."""
+    arr = np.asarray(flat, dtype=np.float32).reshape(k, c, f, f)
+    return jnp.asarray(arr.transpose(2, 3, 1, 0))

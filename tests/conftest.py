@@ -1,0 +1,20 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU-world analogue of the reference's ``mpirun --oversubscribe
+-np N`` localhost testing (scripts/common_test_utils.sh:274-276): N virtual
+XLA host devices stand in for N TPU cores, so sharded paths are exercised
+without a pod.
+"""
+
+import os
+
+# Force CPU even if the ambient environment selects a TPU platform: unit
+# tests must be hermetic and run the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,50 @@
+"""Slow numpy oracles for the four ops, written as explicit loops.
+
+These mirror the *semantics* of the reference's serial layer library
+(v1_serial/src/layers_serial.cpp:37-175) — direct conv with zero padding,
+VALID max pool, edge-truncated cross-channel LRN — and serve as the
+hand-computable ground truth the framework tiers are tested against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def conv2d_np(x, w, b, stride, padding):
+    """x: (H,W,C); w: (F,F,C,K); b: (K,) -> (Ho,Wo,K)."""
+    H, W, C = x.shape
+    F, _, _, K = w.shape
+    Ho = (H - F + 2 * padding) // stride + 1
+    Wo = (W - F + 2 * padding) // stride + 1
+    xp = np.zeros((H + 2 * padding, W + 2 * padding, C), dtype=np.float64)
+    xp[padding : padding + H, padding : padding + W] = x
+    out = np.zeros((Ho, Wo, K), dtype=np.float64)
+    for i in range(Ho):
+        for j in range(Wo):
+            patch = xp[i * stride : i * stride + F, j * stride : j * stride + F]
+            out[i, j] = np.einsum("fgc,fgck->k", patch, w) + b
+    return out
+
+
+def maxpool_np(x, window, stride):
+    H, W, C = x.shape
+    Ho = (H - window) // stride + 1
+    Wo = (W - window) // stride + 1
+    out = np.zeros((Ho, Wo, C), dtype=x.dtype)
+    for i in range(Ho):
+        for j in range(Wo):
+            out[i, j] = x[i * stride : i * stride + window, j * stride : j * stride + window].max(axis=(0, 1))
+    return out
+
+
+def lrn_np(x, size, alpha, beta, k, alpha_over_size=False):
+    H, W, C = x.shape
+    half = size // 2
+    a = alpha / size if alpha_over_size else alpha
+    out = np.zeros_like(x)
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C - 1, c + half)
+        ssum = (x[:, :, lo : hi + 1] ** 2).sum(axis=2)
+        out[:, :, c] = x[:, :, c] / (k + a * ssum) ** beta
+    return out

@@ -1,0 +1,94 @@
+"""Golden-output test: deterministic init must reproduce the reference's
+printed first-10 values.
+
+The reference prints, for deterministic init (input=1.0, w=0.01, b=0.0):
+``Final Output (first 10 values): 29.2932 25.9153 23.3255 23.3255 ...``
+(v4_mpi_cuda/logs_v4_test/v4_np1.log:2, same values from V2.x/V3) with
+``Final Output Shape: 13x13x256``. Values are corner outputs of the flat
+HWC-interleaved output buffer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_mpi_gpu_cluster_programming_tpu.models import (
+    BLOCKS12,
+    deterministic_input,
+    forward_blocks12,
+    init_params_deterministic,
+    init_params_random,
+    output_shape,
+    random_input,
+)
+
+GOLDEN_FIRST10 = np.array(
+    [29.2932, 25.9153, 23.3255, 23.3255, 23.3255, 23.3255, 23.3255, 23.3255, 23.3255, 23.3255],
+    dtype=np.float32,
+)
+
+# The reference's CPU LRN form (alpha/N): 2.2_scatter_halo np=1 log
+# (logs/run_20250509_115115_nixos/run_v2_2.2_scatter_halo_np1.log).
+GOLDEN_CPU_FORM_FIRST5 = np.array([44.4152, 42.4612, 40.6967, 40.6967, 40.6967], dtype=np.float32)
+
+
+def test_deterministic_golden_first10():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    out = jax.jit(forward_blocks12)(params, x)
+    assert out.shape == (1,) + output_shape(BLOCKS12)
+    flat = np.asarray(out[0]).reshape(-1)  # HWC-interleaved, like idx3D
+    np.testing.assert_allclose(flat[:10], GOLDEN_FIRST10, rtol=2e-5)
+
+
+def test_deterministic_golden_cpu_lrn_form():
+    import dataclasses
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import LrnSpec
+
+    cfg = dataclasses.replace(
+        BLOCKS12, lrn2=LrnSpec(5, 1e-4, 0.75, 2.0, alpha_over_size=True)
+    )
+    params = init_params_deterministic(cfg)
+    out = jax.jit(forward_blocks12, static_argnums=2)(params, deterministic_input(1, cfg), cfg)
+    flat = np.asarray(out[0]).reshape(-1)
+    np.testing.assert_allclose(flat[:5], GOLDEN_CPU_FORM_FIRST5, rtol=1e-4)
+
+
+def test_interior_value_analytic():
+    # Interior conv1 output = 11*11*3*0.01 = 3.63; pool passes it through;
+    # interior conv2 = 5*5*96*0.01*3.63 = 87.12; LRN shrinks it.
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import conv2d, maxpool, relu
+
+    c1 = conv2d(x, params["conv1"]["w"], params["conv1"]["b"], stride=4, padding=0)
+    assert np.allclose(np.asarray(c1[0, 27, 27, 0]), 11 * 11 * 3 * 0.01, rtol=1e-5)
+    p1 = maxpool(relu(c1), window=3, stride=2)
+    c2 = conv2d(p1, params["conv2"]["w"], params["conv2"]["b"], stride=1, padding=2)
+    assert np.allclose(np.asarray(c2[0, 13, 13, 0]), 25 * 96 * 0.01 * 3.63, rtol=1e-5)
+
+
+def test_random_init_reproducible():
+    key = jax.random.PRNGKey(485)
+    p1 = init_params_random(key)
+    p2 = init_params_random(key)
+    np.testing.assert_array_equal(p1["conv1"]["w"], p2["conv1"]["w"])
+    x = random_input(key)
+    o1 = jax.jit(forward_blocks12)(p1, x)
+    o2 = jax.jit(forward_blocks12)(p2, x)
+    np.testing.assert_array_equal(o1, o2)
+    # weights/data in [0,1), bias exactly 0.1
+    assert float(p1["conv1"]["w"].min()) >= 0.0 and float(p1["conv1"]["w"].max()) < 1.0
+    np.testing.assert_array_equal(p1["conv2"]["b"], jnp.full((256,), 0.1))
+
+
+def test_batched_forward_matches_batch1():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=4)
+    out = jax.jit(forward_blocks12)(params, x)
+    single = jax.jit(forward_blocks12)(params, deterministic_input(batch=1))
+    # Not required bit-exact: XLA may select a different conv algorithm per
+    # batch size; tiers are bit-compared at fixed shapes elsewhere.
+    for n in range(4):
+        np.testing.assert_allclose(out[n], single[0], rtol=1e-6)

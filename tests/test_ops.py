@@ -1,0 +1,74 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.ops import conv2d, lrn, maxpool, relu
+
+from oracle import conv2d_np, lrn_np, maxpool_np
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(485)
+
+
+def test_conv2d_vs_oracle(rng):
+    x = rng.standard_normal((9, 9, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 5)).astype(np.float32)
+    b = rng.standard_normal(5).astype(np.float32)
+    got = conv2d(jnp.asarray(x)[None], jnp.asarray(w), jnp.asarray(b), stride=2, padding=1)[0]
+    want = conv2d_np(x, w, b, stride=2, padding=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_no_padding(rng):
+    x = rng.standard_normal((11, 11, 2)).astype(np.float32)
+    w = rng.standard_normal((5, 5, 2, 4)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    got = conv2d(jnp.asarray(x)[None], jnp.asarray(w), jnp.asarray(b), stride=4, padding=0)[0]
+    want = conv2d_np(x, w, b, stride=4, padding=0)
+    assert got.shape == (2, 2, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_relu():
+    x = jnp.array([[-1.0, 0.0, 2.5]])
+    np.testing.assert_array_equal(relu(x), jnp.array([[0.0, 0.0, 2.5]]))
+
+
+def test_maxpool_vs_oracle(rng):
+    x = rng.standard_normal((7, 7, 4)).astype(np.float32)
+    got = maxpool(jnp.asarray(x)[None], window=3, stride=2)[0]
+    want = maxpool_np(x, window=3, stride=2)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("alpha_over_size", [False, True])
+def test_lrn_vs_oracle(rng, alpha_over_size):
+    x = rng.standard_normal((4, 4, 8)).astype(np.float32)
+    got = lrn(jnp.asarray(x)[None], size=5, alpha=1e-4, beta=0.75, k=2.0, alpha_over_size=alpha_over_size)[0]
+    want = lrn_np(x, size=5, alpha=1e-4, beta=0.75, k=2.0, alpha_over_size=alpha_over_size)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_edge_truncation():
+    # channel 0's window is [0..2] for size=5: denominator uses only 3 values
+    x = np.ones((1, 1, 6), np.float32)
+    got = np.asarray(
+        lrn(jnp.asarray(x)[None], size=5, alpha=0.5, beta=1.0, k=1.0, alpha_over_size=True)[0]
+    )
+    want = lrn_np(x, size=5, alpha=0.5, beta=1.0, k=1.0, alpha_over_size=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert got[0, 0, 0] == pytest.approx(1.0 / (1.0 + 0.1 * 3))
+    assert got[0, 0, 2] == pytest.approx(1.0 / (1.0 + 0.1 * 5))
+
+
+def test_batch_axis(rng):
+    x = rng.standard_normal((2, 9, 9, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 5)).astype(np.float32)
+    b = rng.standard_normal(5).astype(np.float32)
+    batched = conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), stride=1, padding=1)
+    for n in range(2):
+        single = conv2d(jnp.asarray(x[n])[None], jnp.asarray(w), jnp.asarray(b), stride=1, padding=1)[0]
+        np.testing.assert_allclose(batched[n], single, rtol=1e-6)

@@ -1,0 +1,19 @@
+from cuda_mpi_gpu_cluster_programming_tpu.ops.shapes import conv_out_dim, pool_out_dim
+from cuda_mpi_gpu_cluster_programming_tpu.models import BLOCKS12, output_shape
+
+
+def test_reference_dim_chain():
+    # 227x227x3 -> 55 -> 27 -> 27 -> 13 (run log run_v1_np1.log:5-21)
+    assert conv_out_dim(227, 11, 0, 4) == 55
+    assert pool_out_dim(55, 3, 2) == 27
+    assert conv_out_dim(27, 5, 2, 1) == 27
+    assert pool_out_dim(27, 3, 2) == 13
+    assert output_shape(BLOCKS12) == (13, 13, 256)
+
+
+def test_degenerate_guards():
+    # V4's guards: filter larger than padded input -> 0 (v4 alexnet.hpp:28-33)
+    assert conv_out_dim(3, 11, 0, 4) == 0
+    assert conv_out_dim(0, 3, 1, 1) == 0
+    assert pool_out_dim(2, 3, 2) == 0
+    assert pool_out_dim(13, 3, 0) == 0
