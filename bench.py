@@ -1,0 +1,50 @@
+"""Headline benchmark: AlexNet Blocks 1-2 inference throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best GPU number — V4 MPI+CUDA at np=1 on an
+RTX 3090-class card, 0.183 s per 227x227x3 image (best_runs.md:16,24;
+BASELINE.md) = 5.4645 images/sec. ``vs_baseline`` is the speedup ratio
+against that. Run from the repo root with PYTHONPATH unset (it breaks the
+TPU plugin — see .claude/skills/verify/SKILL.md).
+"""
+
+import json
+import os
+import sys
+
+BASELINE_IMG_PER_SEC = 1.0 / 0.183  # reference V4 best, RTX 3090 (BASELINE.md)
+BATCH = 128
+REPEATS = 30
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import time_fn_ms
+
+    params = init_params_deterministic()
+    x = deterministic_input(batch=BATCH)
+    fwd = build_forward(REGISTRY["v1_jit"])
+
+    timing = time_fn_ms(fwd, params, x, repeats=REPEATS, warmup=2)
+    img_per_sec = BATCH / (timing.best_ms / 1e3)
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_blocks12_images_per_sec",
+                "value": round(img_per_sec, 1),
+                "unit": "img/s",
+                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,139 @@
+"""Execution-config registry: the reference's V1-V5 stages as configs.
+
+The reference implements its parallelization stages as five divergent source
+trees (final_project/v1_serial ... v5_cuda_aware_mpi). Here each stage is an
+``ExecConfig`` selecting (a) the op tier — XLA reference ops or Pallas
+kernels — and (b) the distribution strategy — none, replicate-all, or
+row-sharded with halo exchange — over the *same* model definition.
+
+Stage mapping (version_name strings stay compatible with the reference's
+canonical analysis mapping, analysis.md:69-92, extended with a V6 family):
+
+- ``v1_jit``        ↔ V1 Serial (v1_serial/): single device, XLA ops.
+- ``v2.1_replicated``↔ V2.1 BroadcastAll (2.1_broadcast_all/src/main.cpp:49-87):
+  fully-replicated input+params, every device computes the full pass — kept
+  as the pedagogical anti-baseline.
+- ``v2.2_sharded``  ↔ V2.2 ScatterHalo (2.2_scatter_halo/src/main.cpp:100-249):
+  1-D row decomposition, neighbor halo exchange, XLA ops.
+- ``v3_pallas``     ↔ V3 CUDA (v3_cuda_only/): single device, hand-written
+  Pallas kernels (the TPU counterpart of the .cu kernels).
+- ``v4_hybrid``     ↔ V4 MPI+CUDA (v4_mpi_cuda/): row-sharded with
+  *host-staged-style* halo (all_gather + reslice — the analogue of V4's
+  D2H→MPI→H2D staging) + Pallas kernels per shard.
+- ``v5_collective`` ↔ V5 CUDA-aware MPI (planned-only in the reference,
+  README.md:158-166): row-sharded with direct device-to-device ``ppermute``
+  halos over ICI — the natural state of the TPU backend, exposed as an
+  explicit measured config to reproduce the V4-vs-V5 comparison story.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+
+from .models.alexnet import BLOCKS12, Blocks12Config, forward_blocks12
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecConfig:
+    key: str
+    version_name: str  # canonical name for CSV/analysis compatibility
+    tier: str  # "reference" (XLA ops) | "pallas"
+    strategy: str  # "single" | "replicated" | "halo" | "staged_halo"
+    description: str
+
+
+REGISTRY: Dict[str, ExecConfig] = {
+    c.key: c
+    for c in [
+        ExecConfig(
+            "v1_jit",
+            "V1 Serial",
+            "reference",
+            "single",
+            "single-device jit-compiled XLA ops (serial-CPU analogue)",
+        ),
+        ExecConfig(
+            "v2.1_replicated",
+            "V2.1 BroadcastAll",
+            "reference",
+            "replicated",
+            "fully-replicated compute on every device (anti-baseline)",
+        ),
+        ExecConfig(
+            "v2.2_sharded",
+            "V2.2 ScatterHalo",
+            "reference",
+            "halo",
+            "1-D row decomposition + ppermute halo exchange, XLA ops",
+        ),
+        ExecConfig(
+            "v3_pallas",
+            "V3 CUDA",
+            "pallas",
+            "single",
+            "single-device hand-written Pallas kernels (CUDA-kernel analogue)",
+        ),
+        ExecConfig(
+            "v4_hybrid",
+            "V4 MPI+CUDA",
+            "pallas",
+            "staged_halo",
+            "row-sharded, Pallas per shard, all_gather-staged halos (V4 host-staging analogue)",
+        ),
+        ExecConfig(
+            "v5_collective",
+            "V5 MPI+CUDA-Aware",
+            "pallas",
+            "halo",
+            "row-sharded, Pallas per shard, device-to-device ppermute halos over ICI",
+        ),
+    ]
+}
+
+
+def build_forward(
+    exec_cfg: ExecConfig,
+    model_cfg: Blocks12Config = BLOCKS12,
+    n_shards: int = 1,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Callable:
+    """Return a jitted ``(params, x) -> out`` for the given execution config.
+
+    ``n_shards`` is the TPU analogue of ``mpirun -np N``
+    (scripts/common_test_utils.sh:274-276).
+    """
+    need = n_shards if exec_cfg.strategy != "single" else 1
+    if mesh is None and jax.device_count() < need:
+        raise ValueError(
+            f"config {exec_cfg.key!r} with {n_shards} shards needs {need} devices, "
+            f"have {jax.device_count()} (use XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count=N on CPU to fake a mesh)"
+        )
+
+    if exec_cfg.strategy == "single":
+        if exec_cfg.tier == "pallas":
+            from .ops.pallas_model import forward_blocks12_pallas
+
+            return jax.jit(lambda p, x: forward_blocks12_pallas(p, x, model_cfg))
+        return jax.jit(lambda p, x: forward_blocks12(p, x, model_cfg))
+
+    if exec_cfg.strategy == "replicated":
+        from .parallel.replicated import build_replicated_forward
+
+        return build_replicated_forward(model_cfg, n_shards, mesh=mesh)
+
+    if exec_cfg.strategy in ("halo", "staged_halo"):
+        from .parallel.sharded import build_sharded_forward
+
+        return build_sharded_forward(
+            model_cfg,
+            n_shards,
+            mesh=mesh,
+            tier=exec_cfg.tier,
+            staged=(exec_cfg.strategy == "staged_halo"),
+        )
+
+    raise ValueError(f"unknown strategy {exec_cfg.strategy!r}")

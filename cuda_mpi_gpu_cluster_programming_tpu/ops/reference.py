@@ -73,9 +73,11 @@ def maxpool(x: jax.Array, *, window: int, stride: int) -> jax.Array:
     Reference parity: ``serialMaxPoolLayer`` (v1_serial/src/layers_serial.cpp:94-129)
     — no padding, window max.
     """
+    # Python-scalar init (not jnp.array): under jit the latter becomes a
+    # tracer, defeating JAX's max-monoid recognition and losing autodiff.
     return lax.reduce_window(
         x,
-        jnp.array(-jnp.inf, dtype=x.dtype),
+        -float("inf"),
         lax.max,
         window_dimensions=(1, window, window, 1),
         window_strides=(1, stride, stride, 1),
@@ -114,7 +116,7 @@ def lrn(
     sq = x * x
     ssum = lax.reduce_window(
         sq,
-        jnp.array(0.0, dtype=x.dtype),
+        0.0,
         lax.add,
         window_dimensions=(1, 1, 1, size),
         window_strides=(1, 1, 1, 1),

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, device_count  # noqa: F401

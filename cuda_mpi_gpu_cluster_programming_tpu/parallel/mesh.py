@@ -1,0 +1,45 @@
+"""Device-mesh construction.
+
+The TPU analogue of the reference's process topology: ``mpirun -np N``
+(scripts/common_test_utils.sh:274-276) becomes a 1-D ``jax.sharding.Mesh``
+over N devices whose axis carries the row decomposition ("sp", the
+sequence/context-parallel axis over image height), optionally crossed with a
+data-parallel batch axis ("dp"). Multi-host pods extend the same mesh with a
+DCN axis (see parallel.distributed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    n_shards: int,
+    axis_name: str = "sp",
+    dp: int = 1,
+    dp_axis_name: str = "dp",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(dp, n_shards)`` mesh (1-D when ``dp == 1``).
+
+    Shard axis is innermost so neighbor ``ppermute`` halo shifts ride
+    adjacent-device ICI links.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    need = dp * n_shards
+    if len(devs) < need:
+        raise ValueError(
+            f"mesh needs {need} devices (dp={dp} x shards={n_shards}), have {len(devs)}"
+        )
+    grid = np.array(devs[:need]).reshape(dp, n_shards)
+    if dp == 1:
+        return Mesh(grid.reshape(n_shards), (axis_name,))
+    return Mesh(grid, (dp_axis_name, axis_name))

@@ -1,0 +1,1 @@
+from .timing import time_fn_ms, TimingResult  # noqa: F401

@@ -4,17 +4,25 @@ This is the TPU-world analogue of the reference's ``mpirun --oversubscribe
 -np N`` localhost testing (scripts/common_test_utils.sh:274-276): N virtual
 XLA host devices stand in for N TPU cores, so sharded paths are exercised
 without a pod.
+
+The ambient environment registers a TPU platform at interpreter startup via
+sitecustomize (which imports jax before conftest runs), so plain env-var
+overrides are too late; ``jax.config.update`` still wins as long as no
+backend has been initialized. ``XLA_FLAGS`` is read at backend-init time, so
+setting it here works.
 """
 
 import os
 
-# Force CPU even if the ambient environment selects a TPU platform: unit
-# tests must be hermetic and run the virtual 8-device mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+assert jax.device_count() == 8, (
+    f"tests require the virtual 8-device CPU mesh, got {jax.devices()}"
+)

@@ -1,0 +1,40 @@
+import jax
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+from cuda_mpi_gpu_cluster_programming_tpu.models import (
+    deterministic_input,
+    forward_blocks12,
+    init_params_deterministic,
+)
+
+
+def test_registry_covers_reference_stages():
+    names = {c.version_name for c in REGISTRY.values()}
+    # the canonical analysis names of the reference's five stages + V5
+    assert names == {
+        "V1 Serial",
+        "V2.1 BroadcastAll",
+        "V2.2 ScatterHalo",
+        "V3 CUDA",
+        "V4 MPI+CUDA",
+        "V5 MPI+CUDA-Aware",
+    }
+
+
+def test_v1_jit_matches_direct_forward():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=2)
+    fwd = build_forward(REGISTRY["v1_jit"])
+    np.testing.assert_array_equal(fwd(params, x), jax.jit(forward_blocks12)(params, x))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+def test_replicated_matches_single(n):
+    """V2.1 semantics: every device computes the full pass; result equals V1."""
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    single = build_forward(REGISTRY["v1_jit"])(params, x)
+    repl = build_forward(REGISTRY["v2.1_replicated"], n_shards=n)(params, x)
+    np.testing.assert_allclose(np.asarray(repl), np.asarray(single), rtol=1e-6)

@@ -1,0 +1,74 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import init_params_deterministic
+from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+from cuda_mpi_gpu_cluster_programming_tpu.training import make_train_step
+
+CFG = dataclasses.replace(BLOCKS12, in_height=63, in_width=63)
+
+
+def _data(batch=4):
+    key = jax.random.PRNGKey(7)
+    kx, ky = jax.random.split(key)
+    x = jax.random.uniform(kx, (batch, 63, 63, 3), jnp.float32)
+    y = jax.random.uniform(ky, (batch, 2, 2, 256), jnp.float32)
+    return x, y
+
+
+def test_loss_decreases_single_device():
+    params = init_params_deterministic(CFG)
+    x, y = _data()
+    opt_init, step = make_train_step(CFG, mesh=None, lr=1e-4)
+    opt_state = opt_init(params)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_stateful_optimizer_momentum_actually_accumulates():
+    """Momentum state must thread through steps (regression for a bug where
+    opt state was re-initialized every step, silently degrading to plain SGD)."""
+    import optax
+
+    params = init_params_deterministic(CFG)
+    x, y = _data()
+    opt_init, step = make_train_step(CFG, mesh=None, optimizer=optax.sgd(1e-4, momentum=0.9))
+    opt_state = opt_init(params)
+    # two momentum steps
+    p, s, _ = step(params, opt_state, x, y)
+    p, s, _ = step(p, s, x, y)
+    # two plain-SGD steps
+    opt_init2, step2 = make_train_step(CFG, mesh=None, lr=1e-4)
+    q, t, _ = step2(params, opt_init2(params), x, y)
+    q, t, _ = step2(q, t, x, y)
+    # momentum's second step must differ from plain SGD's
+    a = np.asarray(p["conv1"]["w"])
+    b = np.asarray(q["conv1"]["w"])
+    assert np.abs(a - b).max() > 0
+
+
+def test_sharded_step_matches_unsharded():
+    """dp-sharded training step must agree with the single-device step.
+
+    (H-axis "sp" annotation is deliberately NOT applied in training: GSPMD
+    conv weight-grads under spatial sharding are wrong in this JAX build —
+    see training.x_spec. The mesh still carries an sp axis to prove the
+    step tolerates one.)
+    """
+    mesh = make_mesh(4, dp=2)
+    x, y = _data()
+    p0 = init_params_deterministic(CFG)
+    i1, s1 = make_train_step(CFG, mesh=None, lr=1e-4)
+    i2, s2 = make_train_step(CFG, mesh=mesh, lr=1e-4)
+    p1, _, l1 = s1(p0, i1(p0), x, y)
+    p2, _, l2 = s2(p0, i2(p0), x, y)
+    assert np.isclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
