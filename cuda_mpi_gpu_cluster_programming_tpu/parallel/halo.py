@@ -1,0 +1,82 @@
+"""Neighbor halo exchange over the shard axis via ``ppermute``.
+
+The TPU-native replacement for the reference's nonblocking MPI halo
+exchange (``MPI_Irecv/Isend/Wait`` with tags 0/1 and 2/3,
+2.2_scatter_halo/src/main.cpp:118-135,178-187; V4 host-staged variant
+v4_mpi_cuda/src/main_mpi_cuda.cpp:64-79). Two ``ppermute`` shifts move
+boundary rows directly device-to-device over ICI — the reference's
+planned-but-unbuilt V5 ("CUDA-aware MPI", README.md:158-166) is the
+*default* transport here.
+
+Edge behavior: ``lax.ppermute`` delivers zeros to devices with no source in
+the permutation, which is exactly the zero-fill the reference applies at
+boundary ranks (2.2:124-135) and doubles as the conv's global zero padding.
+
+``halo_exchange_gathered`` is the deliberately-inefficient V4 analogue: it
+all-gathers every shard's block and slices halos locally — the moral
+equivalent of V4 staging halos through host memory — kept as a measured
+config so the V4-vs-V5 comparison story is reproducible on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def halo_exchange(x: jax.Array, h_top: int, h_bot: int, axis_name: str, n_shards: int) -> jax.Array:
+    """Pad a per-shard row block with neighbor halos along axis 1.
+
+    ``x``: (N, B, W, C) block inside shard_map. Returns
+    (N, h_top + B + h_bot, W, C). Shard 0's top and shard n-1's bottom
+    arrive as zeros.
+
+    Halos wider than one block are fetched **multi-hop**: hop ``k`` pulls
+    from the shard ``k`` away (farthest hop sends only the rows still
+    missing). This is what lets shard counts exceed the row count of small
+    late layers — the failure mode the reference could not express at all
+    (its ranks exchange with immediate neighbors only).
+    """
+    b = x.shape[1]
+    parts = []
+    if h_top > 0:
+        k_top = -(-h_top // b)  # ceil
+        for k in range(k_top, 0, -1):  # farthest neighbor first (topmost rows)
+            down = [(j, j + k) for j in range(n_shards - k)]
+            rows = h_top - (k - 1) * b if k == k_top else b
+            parts.append(lax.ppermute(x[:, -rows:] if rows < b else x, axis_name, down))
+    parts.append(x)
+    if h_bot > 0:
+        k_bot = -(-h_bot // b)
+        for k in range(1, k_bot + 1):
+            up = [(j + k, j) for j in range(n_shards - k)]
+            rows = h_bot - (k - 1) * b if k == k_bot else b
+            parts.append(lax.ppermute(x[:, :rows] if rows < b else x, axis_name, up))
+    if len(parts) == 1:
+        return x
+    return jnp.concatenate(parts, axis=1)
+
+
+def halo_exchange_gathered(
+    x: jax.Array, h_top: int, h_bot: int, axis_name: str, n_shards: int
+) -> jax.Array:
+    """V4-style staged halo: all_gather all blocks, slice what's needed.
+
+    Moves n_shards*B rows per device instead of h_top+h_bot — the measured
+    cost of the reference's "stage everything through a central hop" design.
+    """
+    if h_top == 0 and h_bot == 0:
+        return x
+    b = x.shape[1]
+    i = lax.axis_index(axis_name)
+    full = lax.all_gather(x, axis_name, axis=1, tiled=True)  # (N, n*B, W, C)
+    total = n_shards * b
+    # zero-pad both ends so edge shards read zeros, then dynamic-slice
+    padded = jnp.pad(full, ((0, 0), (h_top, h_bot), (0, 0), (0, 0)))
+    start = i * b  # position of this shard's block start inside `padded`
+    return lax.dynamic_slice_in_dim(padded, start, h_top + b + h_bot, axis=1)
+
+
+def exchange(staged: bool):
+    return halo_exchange_gathered if staged else halo_exchange

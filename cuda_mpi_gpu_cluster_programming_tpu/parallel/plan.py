@@ -1,0 +1,179 @@
+"""Static shard planner: exact per-shard output-row ownership.
+
+This is the framework's answer to the reference's one real unsolved bug.
+The reference shards image rows across ranks, computes each layer on a
+halo-padded tile, then *trims* rows with heuristics — and the heuristic trim
+over-removes rows at np=4 (V2.2: gathered 33,280 != expected 43,264,
+run_v2_2.2_scatter_halo_np4.log; V4: gathered 8- and 4-row outputs instead
+of 13, v4_mpi_cuda/logs_v4_test/v4_np{2,4}.log). Its own unused alternative
+path contains the correct global-index mapping (``mapRangeStart/End``,
+v4_mpi_cuda/src/alexnet_mpi_cuda.cu:27-38,58-83). This planner implements
+that exact-ownership semantics, SPMD-statically, and never computes invalid
+rows in the first place:
+
+- Every layer's rows are partitioned into fixed-size blocks of
+  ``ceil(L/n)`` rows per shard (SPMD needs equal block shapes); shard ``i``
+  *owns* global output rows ``[i*B_out, min((i+1)*B_out, L_out))`` — rows
+  past the end are dead and kept zero (the "mask invariant").
+- For a conv/pool with (F, S, P), shard ``i``'s owned output rows need
+  global input rows ``[i*B_out*S - P, (end_own-1)*S - P + F)``. The planner
+  turns that into static top/bottom halo widths (max over shards) plus a
+  per-shard window offset that is affine in the shard index:
+  ``s0(i) = i*(B_out*S - B_in) + (h_top - P)`` — evaluated with
+  ``lax.axis_index`` at runtime, so one compiled program serves all shards.
+- Halos come from single neighbors via ``ppermute``; edge shards receive
+  zeros from ppermute's missing-source semantics, which is exactly the
+  conv's zero padding (shard 0's ``h_top`` requirement includes ``P`` by
+  construction: ``h_top(0) = P``).
+
+All quantities are Python ints computed at trace time — no dynamic shapes
+reach XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Sequence, Tuple
+
+from ..models.alexnet import Blocks12Config, ConvSpec, LrnSpec, PoolSpec
+from ..ops.shapes import conv_out_dim, pool_out_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerPlan:
+    """Static halo/window geometry for one spatial layer on an n-shard mesh."""
+
+    name: str
+    kind: str  # "conv" | "pool" | "pointwise"
+    filter_size: int
+    stride: int
+    padding: int  # H-axis padding handled by halo machinery; W uses op pad
+    l_in: int  # global input rows
+    l_out: int  # global output rows
+    b_in: int  # per-shard input block rows
+    b_out: int  # per-shard output block rows
+    h_top: int  # static top halo rows
+    h_bot: int  # static bottom halo rows
+    s0_coef: int  # window start offset = i*s0_coef + s0_const (local, in padded buf)
+    s0_const: int
+    win_rows: int  # rows of padded buffer consumed: (b_out-1)*stride + filter_size
+    pad_bot: int  # static zero rows appended so the uniform window always fits
+
+    @property
+    def padded_rows(self) -> int:
+        return self.h_top + self.b_in + self.h_bot + self.pad_bot
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    n_shards: int
+    layers: Tuple[LayerPlan, ...]
+
+    @property
+    def b_final(self) -> int:
+        return self.layers[-1].b_out
+
+    @property
+    def l_final(self) -> int:
+        return self.layers[-1].l_out
+
+
+def _plan_spatial_layer(name: str, kind: str, l_in: int, n: int, f: int, s: int, p: int) -> LayerPlan:
+    if kind == "conv":
+        l_out = conv_out_dim(l_in, f, p, s)
+    else:
+        l_out = pool_out_dim(l_in, f, s)
+    if l_out <= 0:
+        raise ValueError(f"layer {name}: degenerate output length {l_out} (l_in={l_in}, f={f}, s={s}, p={p})")
+    b_in = math.ceil(l_in / n)
+    b_out = math.ceil(l_out / n)
+
+    h_top = 0
+    h_bot = 0
+    for i in range(n):
+        own_start = i * b_out
+        own_end = min((i + 1) * b_out, l_out)
+        if own_start >= own_end:
+            continue  # shard owns nothing at this layer; stays masked-zero
+        need_start = own_start * s - p
+        need_end = (own_end - 1) * s - p + f  # exclusive
+        h_top = max(h_top, i * b_in - need_start)
+        h_bot = max(h_bot, need_end - (i + 1) * b_in)
+    h_top = max(h_top, 0)
+    h_bot = max(h_bot, 0)
+
+    # Halos wider than one block are handled multi-hop in halo.halo_exchange;
+    # the only hard cap is the mesh itself (can't reach past shard 0 / n-1,
+    # and rows beyond those edges are zeros == conv zero-padding anyway).
+
+    # Local window start inside [h_top rows | block | h_bot rows | pad_bot zeros]:
+    # s0(i) = need_start(i) - (i*b_in - h_top) = i*(b_out*s - b_in) + h_top - p
+    s0_coef = b_out * s - b_in
+    s0_const = h_top - p
+    # The SPMD-uniform dynamic_slice always reads a full-b_out window, even on
+    # shards owning fewer (or zero) output rows; rows past the communicated
+    # halo only ever feed masked-out outputs, so static zero padding at the
+    # bottom is sufficient (and costs no ICI traffic).
+    win_rows = (b_out - 1) * s + f
+    pad_bot = 0
+    for i in range(n):
+        s0 = max(0, i * s0_coef + s0_const)
+        pad_bot = max(pad_bot, s0 + win_rows - (h_top + b_in + h_bot))
+    for i in range(n):
+        s0 = i * s0_coef + s0_const
+        if min((i + 1) * b_out, l_out) <= i * b_out:
+            continue  # owns nothing: slice start may clamp, outputs are masked
+        if s0 < 0 or s0 + win_rows > h_top + b_in + h_bot + pad_bot:
+            raise ValueError(
+                f"layer {name}: window [{s0}, {s0 + win_rows}) escapes padded buffer "
+                f"rows {h_top + b_in + h_bot + pad_bot} for shard {i}"
+            )
+    return LayerPlan(
+        name=name,
+        kind=kind,
+        filter_size=f,
+        stride=s,
+        padding=p,
+        l_in=l_in,
+        l_out=l_out,
+        b_in=b_in,
+        b_out=b_out,
+        h_top=h_top,
+        h_bot=h_bot,
+        s0_coef=s0_coef,
+        s0_const=s0_const,
+        win_rows=win_rows,
+        pad_bot=pad_bot,
+    )
+
+
+def make_shard_plan(cfg: Blocks12Config, n_shards: int) -> ShardPlan:
+    """Plan every spatial layer of Blocks 1-2 for an ``n_shards`` row mesh."""
+    if n_shards < 1:
+        raise ValueError("n_shards must be >= 1")
+    layers: List[LayerPlan] = []
+    l_cur = cfg.in_height
+    for name, spec in cfg.layer_chain():
+        if isinstance(spec, ConvSpec):
+            lp = _plan_spatial_layer(
+                name, "conv", l_cur, n_shards, spec.filter_size, spec.stride, spec.padding
+            )
+        elif isinstance(spec, PoolSpec):
+            lp = _plan_spatial_layer(name, "pool", l_cur, n_shards, spec.window, spec.stride, 0)
+        elif isinstance(spec, LrnSpec):
+            prev_out = layers[-1].l_out if layers else l_cur
+            b = math.ceil(prev_out / n_shards)
+            lp = LayerPlan(
+                name, "pointwise", 1, 1, 0, prev_out, prev_out, b, b, 0, 0, 0, 0, b, 0
+            )
+        else:
+            raise TypeError(f"unknown layer spec {spec!r}")
+        layers.append(lp)
+        l_cur = lp.l_out
+    return ShardPlan(n_shards=n_shards, layers=tuple(layers))
+
+
+def owned_range(b_out: int, l_out: int, i: int) -> Tuple[int, int]:
+    """Global output rows shard ``i`` owns — the mapRangeStart/End analogue."""
+    return i * b_out, min((i + 1) * b_out, l_out)

@@ -24,6 +24,7 @@ def make_train_step(
     mesh: Mesh | None = None,
     optimizer: optax.GradientTransformation | None = None,
     lr: float = 1e-3,
+    sp_shards: int = 0,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for any optax optimizer (default SGD).
 
@@ -33,8 +34,47 @@ def make_train_step(
     When ``mesh`` is given, activations are constrained to shard batch over
     "dp" (if present); params stay replicated, so XLA emits the all-reduce
     for the gradient sum automatically.
+
+    ``sp_shards >= 1`` instead routes the forward through the explicit
+    shard_map + ppermute halo pipeline (parallel.sharded) over a 1-D "sp"
+    mesh — spatial/context-parallel training. This path is used *instead of*
+    GSPMD H-axis annotation because the latter produces wrong conv weight
+    gradients in this JAX build (see x_spec note below); shard_map's
+    collectives have exact transposes (ppermute^T = reverse permute,
+    replicated-in^T = psum), so gradients here are correct by construction.
     """
     opt = optimizer if optimizer is not None else optax.sgd(lr)
+
+    def _build_step(loss_fn, pre=None, post=None):
+        @jax.jit
+        def step(params, opt_state, x, y):
+            if pre is not None:
+                params, x = pre(params, x)
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, new_opt_state = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if post is not None:
+                new_params = post(new_params)
+            return new_params, new_opt_state, loss
+
+        return step
+
+    if sp_shards and sp_shards >= 1:
+        from .parallel.sharded import build_sharded_forward
+
+        if mesh is not None:
+            sp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp")
+            if sp_size != sp_shards:
+                raise ValueError(
+                    f"mesh 'sp' axis has {sp_size} devices but sp_shards={sp_shards}; "
+                    "the halo/ownership plan would be built for the wrong shard count"
+                )
+        sharded_fwd = build_sharded_forward(cfg, n_shards=sp_shards, mesh=mesh)
+
+        def sp_loss_fn(params, x, y):
+            return jnp.mean((sharded_fwd(params, x) - y) ** 2)
+
+        return opt.init, _build_step(sp_loss_fn)
 
     def x_spec() -> P:
         if mesh is None:
@@ -50,19 +90,19 @@ def make_train_step(
         return P("dp" if "dp" in names else None)
 
     def loss_fn(params, x, y):
-        out = forward_blocks12(params, x, cfg)
-        return jnp.mean((out - y) ** 2)
+        return jnp.mean((forward_blocks12(params, x, cfg) - y) ** 2)
 
-    @jax.jit
-    def step(params, opt_state, x, y):
-        if mesh is not None:
-            x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec()))
-            params = jax.lax.with_sharding_constraint(params, NamedSharding(mesh, P()))
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        updates, new_opt_state = opt.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        if mesh is not None:
-            new_params = jax.lax.with_sharding_constraint(new_params, NamedSharding(mesh, P()))
-        return new_params, new_opt_state, loss
+    def pre(params, x):
+        if mesh is None:
+            return params, x
+        return (
+            jax.lax.with_sharding_constraint(params, NamedSharding(mesh, P())),
+            jax.lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec())),
+        )
 
-    return opt.init, step
+    def post(new_params):
+        if mesh is None:
+            return new_params
+        return jax.lax.with_sharding_constraint(new_params, NamedSharding(mesh, P()))
+
+    return opt.init, _build_step(loss_fn, pre=pre, post=post)
