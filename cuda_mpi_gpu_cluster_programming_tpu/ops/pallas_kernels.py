@@ -1,0 +1,286 @@
+"""Hand-written Pallas TPU kernels for the four layer ops.
+
+The TPU-native counterpart of the reference's CUDA kernels
+(v3_cuda_only/src/layers_cuda.cu:20-152: convKernel, reluKernel, poolKernel,
+lrnKernel; hardened V4 copies v4_mpi_cuda/src/layers_mpi_cuda.cu:25-136).
+NOT a translation: the CUDA kernels map one thread per output element —
+scalar code that would waste the MXU entirely. Here:
+
+- conv: for each (fy, fx) filter tap, a strided window of the image becomes
+  a (Ho*Wo, C) x (C, K) matmul on the MXU, accumulated in fp32 VMEM. The
+  channel axes live on the 128-wide lanes. Bias add + optional ReLU are
+  fused into the same kernel (the reference launches ReLU separately).
+- maxpool: window max via F^2 shifted strided slices, elementwise VPU max.
+- LRN: channel-window sum of squares via shifted adds, one pow + divide —
+  both LRN alpha conventions supported (see ops.reference.lrn).
+
+Grid: one program per batch image; whole padded images sit in VMEM (the
+largest, padded conv1 input, is 231*231*3*4B ~ 640 KB << 16 MB VMEM).
+Accumulation order over filter taps is fixed (row-major fy, fx), giving
+deterministic numerics across runs.
+
+On non-TPU backends the kernels run in Pallas interpreter mode so the same
+code path is unit-testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU; only used for memory-space hints
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_spec(block_shape=None, index_map=None):
+    kw = {}
+    if _VMEM is not None:
+        kw["memory_space"] = _VMEM
+    if block_shape is None:
+        return pl.BlockSpec(**kw)
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, ho: int, wo: int, relu: bool):
+    """Space-to-depth conv: x_ref (1, Hs, Ws, S*S*C), w_ref (fq, fq, S*S*C, K).
+
+    Every tap group is a unit-stride window slice feeding one MXU matmul
+    (Mosaic forbids strided vector slices, and skinny K-dim matmuls would
+    waste the systolic array — the S*S*C contraction axis fixes both).
+    """
+    cs = x_ref.shape[-1]
+    k = w_ref.shape[-1]
+
+    # fori_loop (not Python unroll) so only one window slice is live at a
+    # time — unrolling kept all fq^2 windows in scoped VMEM and OOMed; the
+    # windows are dynamic pl.ds slices of the *ref* (dynamic_slice on loaded
+    # values has no Mosaic lowering). Fixed tap-group order => deterministic
+    # fp32 accumulation (SURVEY §7.3).
+    def tap(idx, acc):
+        qh, qw = idx // fq, idx % fq
+        win = x_ref[0, pl.ds(qh, ho), pl.ds(qw, wo), :]
+        wtap = w_ref[pl.ds(qh, 1), pl.ds(qw, 1), :, :]
+        # HIGHEST: true fp32 MACs on the MXU; the default would round the
+        # operands to bf16 and miss the reference numerics by ~1e-3 rel.
+        return acc + jnp.dot(
+            win.reshape(ho * wo, cs),
+            wtap.reshape(cs, k),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+
+    acc = lax.fori_loop(0, fq * fq, tap, jnp.zeros((ho * wo, k), jnp.float32))
+    out = acc.reshape(ho, wo, k) + b_ref[:].astype(jnp.float32)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _space_to_depth(x: jax.Array, s: int, hs: int, ws: int) -> jax.Array:
+    """(N, H, W, C) -> (N, hs, ws, s*s*C); H, W zero-padded to hs*s, ws*s."""
+    n, h, w, c = x.shape
+    if h < hs * s or w < ws * s:
+        x = jnp.pad(x, ((0, 0), (0, hs * s - h), (0, ws * s - w), (0, 0)))
+    x = x.reshape(n, hs, s, ws, s, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, hs, ws, s * s * c)
+
+
+def _weights_to_depth(w: jax.Array, s: int, fq: int) -> jax.Array:
+    """(F, F, C, K) -> (fq, fq, s*s*C, K), zero taps past F."""
+    f, _, c, k = w.shape
+    if f < fq * s:
+        w = jnp.pad(w, ((0, fq * s - f), (0, fq * s - f), (0, 0), (0, 0)))
+    w = w.reshape(fq, s, fq, s, c, k)
+    return w.transpose(0, 2, 1, 3, 4, 5).reshape(fq, fq, s * s * c, k)
+
+
+@functools.partial(jax.jit, static_argnames=("stride", "padding", "padding_w", "relu"))
+def conv2d_pallas(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array,
+    *,
+    stride: int,
+    padding: int = 0,
+    padding_w: int | None = None,
+    relu: bool = False,
+) -> jax.Array:
+    """Direct conv (+bias, optional fused ReLU). x: (N,H,W,C), w: (F,F,C,K).
+
+    ``padding`` pads H; ``padding_w`` (default = padding) pads W — the split
+    exists for the row-sharded tier, whose halo machinery supplies the H
+    context (VALID on H, padded on W).
+
+    Strided convolution is lowered by phase decomposition (space-to-depth):
+    the input is repacked host-side to (N, H/S, W/S, S*S*C) and the weights
+    to (ceil(F/S)^2, S*S*C, K); output row i tap fy reads s2d row
+    ``i + fy//S`` channel block ``fy%S`` — so the kernel's window slices are
+    all unit-stride and each matmul contracts over S*S*C. For S=1 this
+    degenerates to the identity packing.
+    """
+    n, h, wdt, c = x.shape
+    f = w.shape[0]
+    s = stride
+    pw = padding if padding_w is None else padding_w
+    ph = padding
+    ho = (h - f + 2 * ph) // s + 1
+    wo = (wdt - f + 2 * pw) // s + 1
+    fq = -(-f // s)  # ceil(F/S): tap groups per axis
+
+    if ph or pw:
+        x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    hs, ws = ho + fq - 1, wo + fq - 1  # s2d rows/cols the kernel reads
+    xs = _space_to_depth(x, s, hs, ws)
+    ws2d = _weights_to_depth(w, s, fq)
+    cs = s * s * c
+
+    kernel = functools.partial(_conv_kernel, fq=fq, ho=ho, wo=wo, relu=relu)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            _vmem_spec((1, hs, ws, cs), lambda i: (i, 0, 0, 0)),
+            _vmem_spec(),
+            _vmem_spec(),
+        ],
+        out_specs=_vmem_spec((1, ho, wo, w.shape[-1]), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, ho, wo, w.shape[-1]), x.dtype),
+        interpret=_interpret(),
+    )(xs, ws2d, b)
+
+
+def conv2d_pallas_hvalid(x, w, b, *, stride: int, padding_w: int):
+    """Sharded-tier entry: VALID on H (halo-provided), padded on W, fused ReLU
+    is NOT applied here (the sharded pipeline masks then relus)."""
+    return conv2d_pallas(x, w, b, stride=stride, padding=0, padding_w=padding_w)
+
+
+def _pool_kernel(x_ref, o_ref, *, window: int, stride: int, ho: int, wo: int):
+    """x_ref: (s*s, 1, hp, wp, C) stacked stride-phases; max over window taps.
+
+    Tap (fy, fx) lives in phase (fy % s)*s + (fx % s) at spatial offset
+    (fy//s, fx//s) — every in-kernel slice is unit-stride (Mosaic forbids
+    strided vector slices; the phase split is done host-side by XLA).
+    """
+    s = stride
+    c = x_ref.shape[-1]
+    out = None
+    for fy in range(window):
+        for fx in range(window):
+            ph = (fy % s) * s + (fx % s)
+            qh, qw = fy // s, fx // s
+            win = lax.slice(
+                x_ref[ph, 0], (qh, qw, 0), (qh + ho, qw + wo, c)
+            )
+            out = win if out is None else jnp.maximum(out, win)
+    o_ref[0] = out
+
+
+def _pool_phases(x: jax.Array, s: int, hp: int, wp: int) -> jax.Array:
+    """(N,H,W,C) -> (s*s, N, hp, wp, C): stride-phase views, zero-padded.
+
+    Padded rows/cols are never read: kernel taps stop at fy,fx < window.
+    """
+    n, h, w, c = x.shape
+    phases = []
+    for r in range(s):
+        for p in range(s):
+            v = x[:, r::s, p::s, :]
+            phases.append(
+                jnp.pad(v, ((0, 0), (0, hp - v.shape[1]), (0, wp - v.shape[2]), (0, 0)))
+            )
+    return jnp.stack(phases)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def maxpool_pallas(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+    n, h, wdt, c = x.shape
+    s = stride
+    ho = (h - window) // s + 1
+    wo = (wdt - window) // s + 1
+    qmax = (window - 1) // s
+    hp, wp = ho + qmax, wo + qmax
+    xph = _pool_phases(x, s, hp, wp)
+    kernel = functools.partial(_pool_kernel, window=window, stride=s, ho=ho, wo=wo)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[_vmem_spec((s * s, 1, hp, wp, c), lambda i: (0, i, 0, 0, 0))],
+        out_specs=_vmem_spec((1, ho, wo, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
+        interpret=_interpret(),
+    )(xph)
+
+
+def _lrn_kernel(x_ref, o_ref, *, size: int, alpha: float, beta: float, k: float, alpha_over_size: bool):
+    """Cross-channel LRN; the channel-window sum of squares is a banded
+    0/1-matrix matmul on the MXU — no lane-dimension slicing, and the band
+    edges implement the reference's window truncation exactly."""
+    x = x_ref[0]  # (H, W, C)
+    h, w, c = x.shape
+    half = size // 2
+    ci = lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    cj = lax.broadcasted_iota(jnp.int32, (c, c), 1)
+    band = (jnp.abs(ci - cj) <= half).astype(jnp.float32)
+    sq = (x * x).reshape(h * w, c)
+    ssum = jnp.dot(
+        sq, band, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST
+    ).reshape(h, w, c)
+    a = alpha / size if alpha_over_size else alpha
+    scale = k + a * ssum
+    o_ref[0] = (x / scale**beta).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("size", "alpha", "beta", "k", "alpha_over_size"))
+def lrn_pallas(
+    x: jax.Array,
+    *,
+    size: int,
+    alpha: float,
+    beta: float,
+    k: float,
+    alpha_over_size: bool = False,
+) -> jax.Array:
+    n, h, wdt, c = x.shape
+    kernel = functools.partial(
+        _lrn_kernel, size=size, alpha=alpha, beta=beta, k=k, alpha_over_size=alpha_over_size
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[_vmem_spec((1, h, wdt, c), lambda i: (i, 0, 0, 0))],
+        out_specs=_vmem_spec((1, h, wdt, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x)
+
+
+def relu_pallas(x: jax.Array) -> jax.Array:
+    """Standalone elementwise ReLU kernel (reference: reluKernel,
+    layers_cuda.cu:66-75). The conv kernel fuses ReLU, so this exists for
+    parity/benchmarking of the unfused launch sequence."""
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = jnp.maximum(x_ref[:], 0.0).astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[_vmem_spec()],
+        out_specs=_vmem_spec(),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x)

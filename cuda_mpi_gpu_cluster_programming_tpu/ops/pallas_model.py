@@ -1,0 +1,31 @@
+"""Blocks 1-2 forward pass on the Pallas kernel tier.
+
+The counterpart of the reference's V3 device pass
+(v3_cuda_only/src/alexnet_cuda.cu:22-95: malloc-all → H2D → 7 launches →
+D2H), reduced to 5 fused launches (conv+bias+ReLU fused) with no manual
+memory management — buffers are XLA-managed, eliminating V3/V4's measured
+per-call cudaMalloc/weight-reupload bottleneck (PROBLEMS.txt:114-135).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..models.alexnet import BLOCKS12, Blocks12Config
+from . import pallas_kernels as pk
+
+
+def forward_blocks12_pallas(params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
+    c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
+    x = pk.conv2d_pallas(
+        x, params["conv1"]["w"], params["conv1"]["b"], stride=c1.stride, padding=c1.padding, relu=True
+    )
+    x = pk.maxpool_pallas(x, window=p1.window, stride=p1.stride)
+    x = pk.conv2d_pallas(
+        x, params["conv2"]["w"], params["conv2"]["b"], stride=c2.stride, padding=c2.padding, relu=True
+    )
+    x = pk.maxpool_pallas(x, window=p2.window, stride=p2.stride)
+    x = pk.lrn_pallas(
+        x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
+    )
+    return x

@@ -1,1 +1,1 @@
-from .timing import time_fn_ms, TimingResult  # noqa: F401
+from .timing import time_fn_ms, amortized_ms, sync_fence, TimingResult  # noqa: F401

@@ -94,6 +94,17 @@ def test_small_image_sharded():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("staged", [False, True])
+def test_pallas_tier_inside_sharded(staged, single_out):
+    """v4_hybrid / v5_collective: Pallas kernels per shard (interpret mode on
+    CPU). Regression: pallas_call inside shard_map requires check_vma=False."""
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    fwd = build_sharded_forward(BLOCKS12, n_shards=4, tier="pallas", staged=staged)
+    got = np.asarray(fwd(params, x))
+    np.testing.assert_allclose(got, single_out, rtol=1e-5, atol=1e-5)
+
+
 def test_multihop_halo_tiny_layers():
     """8 shards on a 63x63 image: conv2 sees only 6 rows (<1 per shard), so
     halos must hop multiple neighbors. The reference architecture cannot
