@@ -1,0 +1,421 @@
+"""Bench/test harness: sweep, triage, CSV, ASCII summary table.
+
+Python replacement for the reference's bash harness layer (L5):
+
+- ``scripts/common_test_utils.sh`` — run+classify (exit 0/2/3/4 =
+  ok / env-warn / mpi-warn / critical via log grep, :84-117), CSV row writer
+  (:71-81), box-drawing ASCII summary table (:119-178), per-case pipeline
+  (:187-346).
+- ``scripts/0_run_final_project.sh`` / ``1_final_unique_machine.sh`` — the
+  variant x np sweep matrix (:44-70) and the 20-column CSV schema (:41).
+- ``final_project/v4_mpi_cuda/test_v4.sh`` — per-case log capture + colored
+  PASS/FAIL/WARN summary.
+- ``scripts/test_hw.sh`` — per-run timeout (:124) and sweep skip rules.
+
+Each case runs ``python -m cuda_mpi_gpu_cluster_programming_tpu.run`` in a
+subprocess (the ``mpirun -np N ./template`` analogue); ``--fake-devices``
+maps to ``--oversubscribe`` (N virtual XLA host devices stand in for N TPU
+cores). The stdout contract parsed here is the same one the reference greps
+(``Final Output Shape:`` / first-10 / ``completed in X ms``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import dataclasses
+import datetime
+import os
+import platform
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+# 20-column CSV schema (analogue of 0_run_final_project.sh:41).
+CSV_COLUMNS = [
+    "SessionID",
+    "MachineID",
+    "GitCommit",
+    "Timestamp",
+    "Variant",
+    "ConfigKey",
+    "NP",
+    "Batch",
+    "BuildStatus",
+    "BuildMsg",
+    "RunStatus",
+    "RunMsg",
+    "ParseStatus",
+    "ParseMsg",
+    "Status",
+    "ExecutionTime_ms",
+    "Compile_ms",
+    "OutputShape",
+    "First5Values",
+    "LogFile",
+]
+
+# Exit-code triage classes (common_test_utils.sh:96-116).
+OK, ENV_WARN, MESH_WARN, CRITICAL, FAIL, TIMEOUT, PARSE_ERR = (
+    "OK",
+    "ENV_WARN",
+    "MESH_WARN",
+    "CRITICAL",
+    "FAIL",
+    "TIMEOUT",
+    "PARSE_ERR",
+)
+STATUS_SYMBOL = {
+    OK: "✓",  # ✓
+    ENV_WARN: "⚠",  # ⚠
+    MESH_WARN: "⚠",
+    PARSE_ERR: "⚠",
+    CRITICAL: "✗",  # ✗
+    FAIL: "✗",
+    TIMEOUT: "⏱",  # ⏱
+}
+
+_ENV_PATTERNS = [
+    r"No TPU devices",
+    r"Unable to initialize backend",
+    r"libtpu",
+    r"TPU platform",
+    r"PJRT",
+    r"CUDA_ERROR",
+]
+_MESH_PATTERNS = [
+    r"needs \d+ devices, have \d+",
+    r"xla_force_host_platform_device_count",
+    r"device_count",
+]
+_CRITICAL_PATTERNS = [
+    r"Segmentation fault",
+    r"core dumped",
+    r"Illegal instruction",
+    r"Fatal Python error",
+    r"MemoryError",
+]
+
+
+def classify(returncode: int, log_text: str) -> str:
+    """Classify a finished run (common_test_utils.sh:96-116 analogue).
+
+    Warnings (ENV_WARN / MESH_WARN) don't fail the suite — this is how
+    machines without a TPU / without enough devices still exercise the
+    other paths, exactly like the reference's GPU-less machines.
+    """
+    if returncode == 0:
+        return OK
+    for pat in _CRITICAL_PATTERNS:
+        if re.search(pat, log_text):
+            return CRITICAL
+    for pat in _MESH_PATTERNS:
+        if re.search(pat, log_text):
+            return MESH_WARN
+    for pat in _ENV_PATTERNS:
+        if re.search(pat, log_text):
+            return ENV_WARN
+    return FAIL
+
+
+# Stdout-contract regexes (common_test_utils.sh:296-317 analogue).
+_RE_TIME = re.compile(r"completed in ([0-9.]+) ms")
+_RE_COMPILE = re.compile(r"Compile time: ([0-9.]+) ms")
+_RE_SHAPE = re.compile(r"Final Output Shape: ([0-9x]+)")
+_RE_FIRST = re.compile(r"Final Output \(first 10 values\): (.+)")
+
+
+@dataclasses.dataclass
+class CaseResult:
+    variant: str
+    config_key: str
+    np: int
+    batch: int
+    build_status: str = "OK"
+    build_msg: str = ""
+    run_status: str = FAIL
+    run_msg: str = ""
+    parse_status: str = "OK"
+    parse_msg: str = ""
+    time_ms: Optional[float] = None
+    compile_ms: Optional[float] = None
+    shape: str = ""
+    first5: str = ""
+    log_file: str = ""
+
+    @property
+    def status(self) -> str:
+        if self.run_status != OK:
+            return self.run_status
+        if self.parse_status != "OK":
+            return PARSE_ERR
+        return OK
+
+
+def parse_run_log(text: str, result: CaseResult) -> None:
+    """Extract time/shape/first-values; missing fields degrade to parse
+    errors, not failures (common_test_utils.sh:319-324)."""
+    missing = []
+    m = _RE_TIME.search(text)
+    if m:
+        result.time_ms = float(m.group(1))
+    else:
+        missing.append("time")
+    m = _RE_COMPILE.search(text)
+    if m:
+        result.compile_ms = float(m.group(1))
+        result.build_msg = f"jit compile {result.compile_ms:.0f} ms"
+    m = _RE_SHAPE.search(text)
+    if m:
+        result.shape = m.group(1)
+    else:
+        missing.append("shape")
+    m = _RE_FIRST.search(text)
+    if m:
+        result.first5 = " ".join(m.group(1).split()[:5])
+    else:
+        missing.append("values")
+    if missing:
+        result.parse_status = PARSE_ERR
+        result.parse_msg = "missing: " + ",".join(missing)
+
+
+def git_commit() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            cwd=Path(__file__).resolve().parent.parent,
+            timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+@dataclasses.dataclass
+class Session:
+    """A harness session: one log dir, one CSV (0_run_final_project.sh:15-23)."""
+
+    log_root: Path
+    session_id: str = ""
+    machine_id: str = ""
+    commit: str = ""
+
+    def __post_init__(self) -> None:
+        ts = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+        self.machine_id = self.machine_id or platform.node() or "unknown"
+        self.session_id = self.session_id or f"bench_{ts}_{self.machine_id}"
+        self.commit = self.commit or git_commit()
+        self.dir = self.log_root / self.session_id
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.csv_path = self.dir / "summary.csv"
+        with open(self.csv_path, "w", newline="") as f:
+            csv.writer(f).writerow(CSV_COLUMNS)
+
+    def log_row(self, r: CaseResult) -> None:
+        with open(self.csv_path, "a", newline="") as f:
+            csv.writer(f).writerow(
+                [
+                    self.session_id,
+                    self.machine_id,
+                    self.commit,
+                    datetime.datetime.now().isoformat(timespec="seconds"),
+                    r.variant,
+                    r.config_key,
+                    r.np,
+                    r.batch,
+                    r.build_status,
+                    r.build_msg,
+                    r.run_status,
+                    r.run_msg,
+                    r.parse_status,
+                    r.parse_msg,
+                    r.status,
+                    f"{r.time_ms:.3f}" if r.time_ms is not None else "",
+                    f"{r.compile_ms:.1f}" if r.compile_ms is not None else "",
+                    r.shape,
+                    r.first5,
+                    r.log_file,
+                ]
+            )
+
+
+def run_case(
+    session: Session,
+    config_key: str,
+    variant: str,
+    np_: int,
+    batch: int,
+    timeout_s: float = 300.0,
+    fake_devices: int = 0,
+    extra_args: Sequence[str] = (),
+) -> CaseResult:
+    """Build→run→parse pipeline for one case (common_test_utils.sh:223-346).
+
+    There is no ``make`` step on TPU; the "build" is XLA jit compilation,
+    reported by the runner as ``Compile time:`` and recorded in BuildMsg.
+    """
+    r = CaseResult(variant=variant, config_key=config_key, np=np_, batch=batch)
+    safe_key = config_key.replace(".", "_")
+    log_path = session.dir / f"run_{safe_key}_np{np_}_b{batch}.log"
+    r.log_file = log_path.name
+
+    cmd = [
+        sys.executable,
+        "-m",
+        "cuda_mpi_gpu_cluster_programming_tpu.run",
+        "--config",
+        config_key,
+        "--shards",
+        str(np_),
+        "--batch",
+        str(batch),
+        *extra_args,
+    ]
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # breaks the TPU plugin (see verify skill)
+    if fake_devices:
+        # The --oversubscribe analogue: N virtual host devices on CPU.
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={fake_devices}"
+        ).strip()
+
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=Path(__file__).resolve().parent.parent,
+        )
+        text = proc.stdout + "\n--- stderr ---\n" + proc.stderr
+        r.run_status = classify(proc.returncode, text)
+        if r.run_status != OK:
+            last = [ln for ln in proc.stderr.strip().splitlines() if ln.strip()]
+            r.run_msg = (last[-1][:160] if last else f"exit {proc.returncode}")
+    except subprocess.TimeoutExpired as e:
+        text = (e.stdout or "") + "\n--- stderr ---\n" + (e.stderr or "")
+        r.run_status = TIMEOUT
+        r.run_msg = f"timeout after {timeout_s:.0f}s"
+    wall = time.perf_counter() - t0
+    log_path.write_text(f"$ {' '.join(cmd)}\n# wall {wall:.2f}s\n{text}")
+
+    if r.run_status == OK:
+        parse_run_log(text, r)
+    session.log_row(r)
+    return r
+
+
+def summary_table(results: List[CaseResult]) -> str:
+    """Unicode box-drawing summary (common_test_utils.sh:133-178 analogue)."""
+    headers = ["Variant", "Config", "NP", "Batch", "St", "Time(ms)", "Shape", "First values"]
+    rows = []
+    for r in results:
+        rows.append(
+            [
+                r.variant,
+                r.config_key,
+                str(r.np),
+                str(r.batch),
+                STATUS_SYMBOL.get(r.status, "?"),
+                f"{r.time_ms:.3f}" if r.time_ms is not None else "-",
+                r.shape or "-",
+                (r.first5[:28] or r.run_msg[:28]) or "-",
+            ]
+        )
+    widths = [max(len(h), *(len(row[i]) for row in rows)) if rows else len(h) for i, h in enumerate(headers)]
+
+    def line(l: str, m: str, r_: str) -> str:
+        return l + m.join("─" * (w + 2) for w in widths) + r_
+
+    def fmt(cells: List[str]) -> str:
+        return "│" + "│".join(f" {c:<{w}} " for c, w in zip(cells, widths)) + "│"
+
+    out = [line("┌", "┬", "┐"), fmt(headers), line("├", "┼", "┤")]
+    out += [fmt(row) for row in rows]
+    out.append(line("└", "┴", "┘"))
+    return "\n".join(out)
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cuda_mpi_gpu_cluster_programming_tpu.harness")
+    p.add_argument(
+        "--configs",
+        default="v1_jit,v2.1_replicated,v2.2_sharded,v3_pallas,v4_hybrid,v5_collective",
+        help="comma-separated config keys (default: full V1-V5 matrix)",
+    )
+    p.add_argument("--shards", default="1,2,4", help="comma-separated shard counts (np sweep)")
+    p.add_argument("--batches", default="1", help="comma-separated batch sizes")
+    p.add_argument("--timeout", type=float, default=300.0, help="per-case timeout seconds")
+    p.add_argument(
+        "--fake-devices",
+        type=int,
+        default=0,
+        help="run cases on N virtual CPU devices (mpirun --oversubscribe analogue); "
+        "0 = use the real backend",
+    )
+    p.add_argument("--log-root", default="logs", help="session log directory root")
+    p.add_argument("--height", type=int, default=227)
+    p.add_argument("--width", type=int, default=227)
+    p.add_argument("--repeats", type=int, default=10)
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    from .configs import REGISTRY
+
+    configs = [c.strip() for c in args.configs.split(",") if c.strip()]
+    shard_counts = [int(s) for s in args.shards.split(",")]
+    batches = [int(b) for b in args.batches.split(",")]
+    unknown = [c for c in configs if c not in REGISTRY]
+    if unknown:
+        print(f"unknown configs: {unknown}", file=sys.stderr)
+        return 2
+
+    session = Session(log_root=Path(args.log_root))
+    print(f"Session: {session.session_id} (commit {session.commit})")
+    print(f"Logs:    {session.dir}")
+
+    extra = ["--height", str(args.height), "--width", str(args.width), "--repeats", str(args.repeats)]
+    results: List[CaseResult] = []
+    for key in configs:
+        variant = REGISTRY[key].version_name
+        single = REGISTRY[key].strategy == "single"
+        for np_ in [1] if single else shard_counts:
+            for batch in batches:
+                fake = args.fake_devices if (args.fake_devices and args.fake_devices >= np_) else args.fake_devices
+                print(f"[{key} np={np_} b={batch}] ...", end="", flush=True)
+                r = run_case(
+                    session,
+                    key,
+                    variant,
+                    np_,
+                    batch,
+                    timeout_s=args.timeout,
+                    fake_devices=fake,
+                    extra_args=extra,
+                )
+                results.append(r)
+                tail = f"{r.time_ms:.1f} ms" if r.time_ms is not None else r.run_msg
+                print(f" {STATUS_SYMBOL.get(r.status, '?')} {r.status} {tail}")
+
+    print()
+    print(summary_table(results))
+    print(f"\nCSV: {session.csv_path}")
+    # Warnings don't fail the suite (common_test_utils.sh exit semantics).
+    worst = {CRITICAL: 4, FAIL: 1, TIMEOUT: 2}
+    return max((worst.get(r.status, 0) for r in results), default=0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -91,10 +91,18 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, ho: int, wo: int, relu:
 
 
 def _space_to_depth(x: jax.Array, s: int, hs: int, ws: int) -> jax.Array:
-    """(N, H, W, C) -> (N, hs, ws, s*s*C); H, W zero-padded to hs*s, ws*s."""
+    """(N, H, W, C) -> (N, hs, ws, s*s*C); H, W zero-padded to hs*s, ws*s.
+
+    Geometries where (H - F) % S != 0 leave trailing rows/cols the conv
+    never reads — cropped here, matching the reference's floor-division
+    output dims (convOutDim, v2_mpi_only/2.2_scatter_halo/include/alexnet.hpp:35-39).
+    """
     n, h, w, c = x.shape
     if h < hs * s or w < ws * s:
-        x = jnp.pad(x, ((0, 0), (0, hs * s - h), (0, ws * s - w), (0, 0)))
+        x = jnp.pad(
+            x, ((0, 0), (0, max(0, hs * s - h)), (0, max(0, ws * s - w)), (0, 0))
+        )
+    x = x[:, : hs * s, : ws * s, :]
     x = x.reshape(n, hs, s, ws, s, c)
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, hs, ws, s * s * c)
 
@@ -199,7 +207,7 @@ def _pool_phases(x: jax.Array, s: int, hp: int, wp: int) -> jax.Array:
     phases = []
     for r in range(s):
         for p in range(s):
-            v = x[:, r::s, p::s, :]
+            v = x[:, r::s, p::s, :][:, :hp, :wp, :]  # crop phases longer than hp/wp
             phases.append(
                 jnp.pad(v, ((0, 0), (0, hp - v.shape[1]), (0, wp - v.shape[2]), (0, 0)))
             )

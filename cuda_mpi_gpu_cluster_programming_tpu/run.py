@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import sys
+import time
 
 import jax
 import numpy as np
@@ -60,7 +61,7 @@ def main(argv=None) -> int:
         init_params_random,
         random_input,
     )
-    from .utils.timing import amortized_ms, time_fn_ms
+    from .utils.timing import amortized_ms
 
     if args.list_configs:
         for c in REGISTRY.values():
@@ -98,7 +99,9 @@ def main(argv=None) -> int:
     except (ValueError, NotImplementedError, ModuleNotFoundError) as e:
         print(f"cannot build config {exec_cfg.key!r}: {e}", file=sys.stderr)
         return 2
-    timing = time_fn_ms(fwd, params, x, repeats=1, warmup=0)  # compile probe
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwd(params, x))
+    compile_ms = (time.perf_counter() - t0) * 1e3
     n_small = max(1, args.warmup)
     per_pass_ms = amortized_ms(
         fwd, params, x, n_small=n_small, n_large=n_small + max(1, args.repeats)
@@ -108,7 +111,7 @@ def main(argv=None) -> int:
     h, w, c = output_shape(model_cfg)
     flat = out[0].reshape(-1)
     first10 = " ".join(f"{v:.4f}" for v in flat[:10])
-    print(f"Compile time: {timing.compile_ms:.1f} ms")
+    print(f"Compile time: {compile_ms:.1f} ms")
     print(f"Final Output Shape: {h}x{w}x{c}")
     print(f"Final Output (first 10 values): {first10}")
     print(

@@ -106,3 +106,35 @@ def test_small_geometry():
     got = np.asarray(forward_blocks12_pallas(params, x, cfg))
     want = np.asarray(jax.jit(lambda p, v: forward_blocks12(p, v, cfg))(params, x))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_conv_pallas_leftover_rows():
+    """(H - F) % S != 0 geometries must crop, not crash (230 -> 55 rows)."""
+    import jax
+    import jax.numpy as jnp
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import reference as ops
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    key = jax.random.PRNGKey(7)
+    kx, kw = jax.random.split(key)
+    x = jax.random.uniform(kx, (1, 230, 230, 3), jnp.float32)
+    w = jax.random.uniform(kw, (11, 11, 3, 8), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+    got = conv2d_pallas(x, w, b, stride=4, padding=0)
+    want = ops.conv2d(x, w, b, stride=4, padding=0)
+    assert got.shape == want.shape == (1, 55, 55, 8)
+    assert jnp.allclose(got, want, atol=1e-4)
+
+
+def test_maxpool_pallas_even_window_leftover():
+    """window=2 stride=2 on odd H: stride-phase views longer than hp must crop."""
+    import jax
+    import jax.numpy as jnp
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import reference as ops
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import maxpool_pallas
+
+    x = jax.random.uniform(jax.random.PRNGKey(3), (1, 11, 11, 4), jnp.float32)
+    got = maxpool_pallas(x, window=2, stride=2)
+    want = ops.maxpool(x, window=2, stride=2)
+    assert got.shape == want.shape == (1, 5, 5, 4)
+    assert jnp.array_equal(got, want)
