@@ -1,0 +1,373 @@
+"""Performance-analysis ETL: warehouse, stats, speedup, plots, exports.
+
+Python analogue of the reference's L6 layer (``log_analysis.py``, 296 LoC,
+Typer + DuckDB). DuckDB is not in this image, so the warehouse is stdlib
+``sqlite3`` with registered aggregate functions giving the same SQL-view
+surface; the command set is identical:
+
+- ``ingest``  — walk a logs root, SHA1-dedup files (log_analysis.py:104,113-115),
+  load harness summary CSVs, scrape run logs by regex, compute source stats
+  (log_analysis.py:75-160 analogue).
+- ``stats``   — run_stats view: n, mean, stddev, 95% CI per variant/np/batch
+  (log_analysis.py:176-198).
+- ``speedup`` — S(N)=T1/TN and E=S/N against the V1 serial baseline, in SQL
+  (log_analysis.py:213-222).
+- ``plot``    — matplotlib speedup/efficiency PNGs (log_analysis.py:226-266).
+- ``export``  — dump any view to csv/parquet (log_analysis.py:269-292).
+
+Variant names ingested from the harness CSVs use the reference's canonical
+version-name mapping (analysis.md:60-80) extended with the V6 TPU family, so
+historical reference data and new TPU data plot on the same axes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import hashlib
+import math
+import sqlite3
+import statistics
+import sys
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+DEFAULT_DB = ".warehouse/cluster_logs.sqlite"
+
+# Canonical version-name normalisation (analysis.md:60-80 analogue): maps raw
+# variant strings from either the reference's CSVs or ours onto one family.
+CANONICAL_VARIANTS = {
+    "v1": "V1 Serial",
+    "v1 serial": "V1 Serial",
+    "v1_serial": "V1 Serial",
+    "v2.1": "V2.1 BroadcastAll",
+    "v2 2.1-broadcast-all": "V2.1 BroadcastAll",
+    "v2.1 broadcastall": "V2.1 BroadcastAll",
+    "v2.2": "V2.2 ScatterHalo",
+    "v2 2.2-scatter-halo": "V2.2 ScatterHalo",
+    "v2.2 scatterhalo": "V2.2 ScatterHalo",
+    "v3": "V3 CUDA",
+    "v3 cuda": "V3 CUDA",
+    "v4": "V4 MPI+CUDA",
+    "v4 mpi+cuda": "V4 MPI+CUDA",
+    "v5": "V5 MPI+CUDA-Aware",
+    "v5 mpi+cuda-aware": "V5 MPI+CUDA-Aware",
+}
+
+
+def canonical_variant(name: str) -> str:
+    return CANONICAL_VARIANTS.get(name.strip().lower(), name.strip())
+
+
+class _Stdev:
+    """Sample stddev aggregate (DuckDB's stddev_samp analogue for sqlite)."""
+
+    def __init__(self) -> None:
+        self.vals: List[float] = []
+
+    def step(self, v) -> None:
+        if v is not None:
+            self.vals.append(float(v))
+
+    def finalize(self) -> Optional[float]:
+        return statistics.stdev(self.vals) if len(self.vals) > 1 else 0.0
+
+
+def connect(db_path: str | Path) -> sqlite3.Connection:
+    path = Path(db_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(path)
+    conn.create_aggregate("stddev_samp", 1, _Stdev)
+    conn.executescript(
+        """
+        CREATE TABLE IF NOT EXISTS file_index (
+            path TEXT PRIMARY KEY, sha1 TEXT, kind TEXT, ingested_at TEXT
+        );
+        CREATE TABLE IF NOT EXISTS summary_runs (
+            session_id TEXT, machine_id TEXT, git_commit TEXT, ts TEXT,
+            variant TEXT, config_key TEXT, np INTEGER, batch INTEGER,
+            build_status TEXT, run_status TEXT, parse_status TEXT, status TEXT,
+            time_ms REAL, compile_ms REAL, shape TEXT, first5 TEXT,
+            log_file TEXT, src_csv TEXT
+        );
+        CREATE TABLE IF NOT EXISTS run_logs (
+            path TEXT, session_id TEXT, time_ms REAL, shape TEXT
+        );
+        CREATE TABLE IF NOT EXISTS source_stats (
+            path TEXT PRIMARY KEY, loc INTEGER, lang TEXT
+        );
+        CREATE VIEW IF NOT EXISTS perf_runs AS
+            SELECT session_id, machine_id, git_commit, variant, config_key,
+                   np, batch, time_ms, compile_ms, shape
+            FROM summary_runs
+            WHERE status = 'OK' AND time_ms IS NOT NULL;
+        CREATE VIEW IF NOT EXISTS best_runs AS
+            SELECT variant, np, batch, MIN(time_ms) AS best_ms, COUNT(*) AS n
+            FROM perf_runs GROUP BY variant, np, batch;
+        CREATE VIEW IF NOT EXISTS run_stats AS
+            SELECT variant, np, batch, COUNT(*) AS n,
+                   AVG(time_ms) AS mean_ms,
+                   stddev_samp(time_ms) AS stdev_ms,
+                   1.96 * stddev_samp(time_ms) / SQRT(COUNT(*)) AS ci95_ms
+            FROM perf_runs GROUP BY variant, np, batch;
+        """
+    )
+    return conn
+
+
+def _sha1(path: Path) -> str:
+    h = hashlib.sha1()
+    h.update(path.read_bytes())
+    return h.hexdigest()
+
+
+def _already_ingested(conn: sqlite3.Connection, path: Path, sha1: str) -> bool:
+    row = conn.execute("SELECT sha1 FROM file_index WHERE path=?", (str(path),)).fetchone()
+    return row is not None and row[0] == sha1
+
+
+def _mark(conn: sqlite3.Connection, path: Path, sha1: str, kind: str) -> None:
+    conn.execute(
+        "INSERT OR REPLACE INTO file_index VALUES (?,?,?,datetime('now'))",
+        (str(path), sha1, kind),
+    )
+
+
+def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
+    """Load one harness summary.csv (20-column schema, harness.CSV_COLUMNS)."""
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    conn.execute("DELETE FROM summary_runs WHERE src_csv=?", (str(path),))
+    n = 0
+    for r in rows:
+        conn.execute(
+            "INSERT INTO summary_runs VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                r.get("SessionID"),
+                r.get("MachineID"),
+                r.get("GitCommit"),
+                r.get("Timestamp"),
+                canonical_variant(r.get("Variant", "")),
+                r.get("ConfigKey"),
+                int(r["NP"]) if r.get("NP") else None,
+                int(r["Batch"]) if r.get("Batch") else None,
+                r.get("BuildStatus"),
+                r.get("RunStatus"),
+                r.get("ParseStatus"),
+                r.get("Status"),
+                float(r["ExecutionTime_ms"]) if r.get("ExecutionTime_ms") else None,
+                float(r["Compile_ms"]) if r.get("Compile_ms") else None,
+                r.get("OutputShape"),
+                r.get("First5Values"),
+                r.get("LogFile"),
+                str(path),
+            ),
+        )
+        n += 1
+    return n
+
+
+def ingest_run_log(conn: sqlite3.Connection, path: Path) -> int:
+    """Regex-scrape one run log (log_analysis.py run-log scrape analogue)."""
+    from .harness import _RE_SHAPE, _RE_TIME
+
+    text = path.read_text(errors="replace")
+    t = _RE_TIME.search(text)
+    s = _RE_SHAPE.search(text)
+    conn.execute("DELETE FROM run_logs WHERE path=?", (str(path),))
+    conn.execute(
+        "INSERT INTO run_logs VALUES (?,?,?,?)",
+        (
+            str(path),
+            path.parent.name,
+            float(t.group(1)) if t else None,
+            s.group(1) if s else None,
+        ),
+    )
+    return 1
+
+
+_LANG = {".py": "python", ".sh": "bash", ".cpp": "c++", ".cc": "c++", ".h": "c++", ".hpp": "c++"}
+
+
+def ingest_source_stats(conn: sqlite3.Connection, repo_root: Path) -> int:
+    n = 0
+    for p in sorted(repo_root.rglob("*")):
+        if p.suffix not in _LANG or not p.is_file() or ".git" in p.parts:
+            continue
+        loc = sum(1 for _ in open(p, errors="replace"))
+        conn.execute(
+            "INSERT OR REPLACE INTO source_stats VALUES (?,?,?)",
+            (str(p.relative_to(repo_root)), loc, _LANG[p.suffix]),
+        )
+        n += 1
+    return n
+
+
+def cmd_ingest(conn: sqlite3.Connection, logs_root: Path, repo_root: Optional[Path]) -> None:
+    n_csv = n_log = skipped = 0
+    for path in sorted(logs_root.rglob("*")):
+        if not path.is_file():
+            continue
+        if path.name.endswith(".csv") and "summary" in path.name:
+            kind = "summary_csv"
+        elif path.suffix == ".log":
+            kind = "run_log"
+        else:
+            continue
+        sha1 = _sha1(path)
+        if _already_ingested(conn, path, sha1):  # incremental re-ingest
+            skipped += 1
+            continue
+        if kind == "summary_csv":
+            n_csv += ingest_summary_csv(conn, path)
+        else:
+            n_log += ingest_run_log(conn, path)
+        _mark(conn, path, sha1, kind)
+    n_src = ingest_source_stats(conn, repo_root) if repo_root else 0
+    conn.commit()
+    print(f"ingested: {n_csv} csv rows, {n_log} run logs, {n_src} source files, {skipped} unchanged")
+
+
+SPEEDUP_SQL = """
+WITH base AS (
+    SELECT batch, MIN(best_ms) AS t1_ms FROM best_runs
+    WHERE variant = ? AND np = 1 GROUP BY batch
+)
+SELECT b.variant, b.np, b.batch, b.best_ms,
+       base.t1_ms / b.best_ms AS speedup,
+       base.t1_ms / b.best_ms / b.np AS efficiency
+FROM best_runs b JOIN base ON base.batch = b.batch
+ORDER BY b.variant, b.batch, b.np
+"""
+
+
+def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
+    rows = conn.execute(SPEEDUP_SQL, (baseline,)).fetchall()
+    if not rows:
+        print(f"no data (is there a '{baseline}' np=1 run ingested?)", file=sys.stderr)
+        return []
+    print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'best_ms':>10s} {'S(N)':>7s} {'E(N)':>6s}")
+    for v, np_, b, ms, s, e in rows:
+        print(f"{v:22s} {np_:3d} {b:5d} {ms:10.3f} {s:7.2f} {e:6.2f}")
+    return rows
+
+
+def cmd_stats(conn: sqlite3.Connection) -> None:
+    rows = conn.execute(
+        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms FROM run_stats "
+        "ORDER BY variant, batch, np"
+    ).fetchall()
+    print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'n':>4s} {'mean_ms':>10s} {'stdev':>8s} {'ci95':>8s}")
+    for v, np_, b, n, mean, sd, ci in rows:
+        print(f"{v:22s} {np_:3d} {b:5d} {n:4d} {mean:10.3f} {sd or 0:8.3f} {ci or 0:8.3f}")
+
+
+def cmd_plot(conn: sqlite3.Connection, out_dir: Path, baseline: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = conn.execute(SPEEDUP_SQL, (baseline,)).fetchall()
+    if not rows:
+        print("no data to plot", file=sys.stderr)
+        return
+    out_dir.mkdir(parents=True, exist_ok=True)
+    by_variant: dict = {}
+    for v, np_, b, ms, s, e in rows:
+        by_variant.setdefault((v, b), []).append((np_, s, e))
+    for idx, (title, ylab, fname) in enumerate(
+        [("Speedup vs serial baseline", "S(N) = T1/TN", "speedup.png"),
+         ("Parallel efficiency", "E(N) = S(N)/N", "efficiency.png")]
+    ):
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for (v, b), pts in sorted(by_variant.items()):
+            pts.sort()
+            xs = [p[0] for p in pts]
+            ys = [p[1 + idx] for p in pts]
+            ax.plot(xs, ys, marker="o", label=f"{v} (b={b})")
+        if idx == 0:
+            lim = max(p[0] for pts in by_variant.values() for p in pts)
+            ax.plot([1, lim], [1, lim], "k--", alpha=0.4, label="ideal")
+        else:
+            ax.axhline(1.0, color="k", ls="--", alpha=0.4)
+        ax.set_xlabel("shard count (np)")
+        ax.set_ylabel(ylab)
+        ax.set_title(title)
+        ax.legend(fontsize=7)
+        fig.tight_layout()
+        fig.savefig(out_dir / fname, dpi=120)
+        plt.close(fig)
+        print(f"wrote {out_dir / fname}")
+
+
+VIEWS = ("perf_runs", "best_runs", "run_stats", "summary_runs", "run_logs", "source_stats")
+
+
+def cmd_export(conn: sqlite3.Connection, view: str, out: Path, fmt: str) -> None:
+    if view not in VIEWS:
+        raise SystemExit(f"unknown view {view!r}; choose from {VIEWS}")
+    cur = conn.execute(f"SELECT * FROM {view}")  # noqa: S608 — view name validated above
+    cols = [d[0] for d in cur.description]
+    rows = cur.fetchall()
+    out.parent.mkdir(parents=True, exist_ok=True)
+    if fmt == "csv":
+        with open(out, "w", newline="") as f:
+            wtr = csv.writer(f)
+            wtr.writerow(cols)
+            wtr.writerows(rows)
+    elif fmt == "parquet":
+        import pandas as pd
+
+        pd.DataFrame(rows, columns=cols).to_parquet(out)
+    else:
+        raise SystemExit(f"unknown format {fmt!r}")
+    print(f"exported {len(rows)} rows from {view} to {out}")
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cuda_mpi_gpu_cluster_programming_tpu.analysis")
+    p.add_argument("--db", default=DEFAULT_DB)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pi = sub.add_parser("ingest", help="walk logs root, dedup, load warehouse")
+    pi.add_argument("--logs", default="logs")
+    pi.add_argument("--repo-root", default=".", help="root for source stats ('' to skip)")
+    sub.add_parser("stats", help="run_stats view (n/mean/stddev/95%% CI)")
+    ps = sub.add_parser("speedup", help="S(N)=T1/TN and E=S/N vs baseline")
+    ps.add_argument("--baseline", default="V1 Serial")
+    pp = sub.add_parser("plot", help="speedup/efficiency PNGs")
+    pp.add_argument("--out", default="plots")
+    pp.add_argument("--baseline", default="V1 Serial")
+    pe = sub.add_parser("export", help="dump a view to csv/parquet")
+    pe.add_argument("--view", required=True)
+    pe.add_argument("--out", required=True)
+    pe.add_argument("--fmt", choices=["csv", "parquet"], default="csv")
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    conn = connect(args.db)
+    try:
+        if args.cmd == "ingest":
+            cmd_ingest(
+                conn,
+                Path(args.logs),
+                Path(args.repo_root) if args.repo_root else None,
+            )
+        elif args.cmd == "stats":
+            cmd_stats(conn)
+        elif args.cmd == "speedup":
+            cmd_speedup(conn, args.baseline)
+        elif args.cmd == "plot":
+            cmd_plot(conn, Path(args.out), args.baseline)
+        elif args.cmd == "export":
+            cmd_export(conn, args.view, Path(args.out), args.fmt)
+    finally:
+        conn.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,6 +46,8 @@ def make_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--height", type=int, default=227)
     p.add_argument("--width", type=int, default=227)
+    p.add_argument("--params", help="load weights from this .npz checkpoint instead of --init")
+    p.add_argument("--save-params", help="save the weights used to this .npz checkpoint")
     p.add_argument("--list-configs", action="store_true")
     return p
 
@@ -85,7 +87,15 @@ def main(argv=None) -> int:
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind} "
           f"({jax.default_backend()})")
 
-    if args.init == "deterministic":
+    if args.params:
+        from .utils.checkpoint import load_params_npz
+
+        params = load_params_npz(args.params)
+        print(f"Loaded params from {args.params}")
+        x = deterministic_input(args.batch, model_cfg) if args.init == "deterministic" else (
+            random_input(jax.random.PRNGKey(args.seed), args.batch, model_cfg)
+        )
+    elif args.init == "deterministic":
         params = init_params_deterministic(model_cfg)
         x = deterministic_input(args.batch, model_cfg)
     else:
@@ -93,6 +103,11 @@ def main(argv=None) -> int:
         kp, kx = jax.random.split(key)
         params = init_params_random(kp, model_cfg)
         x = random_input(kx, args.batch, model_cfg)
+    if args.save_params:
+        from .utils.checkpoint import save_params_npz
+
+        save_params_npz(args.save_params, params)
+        print(f"Saved params to {args.save_params}")
 
     try:
         fwd = build_forward(exec_cfg, model_cfg, n_shards=args.shards)

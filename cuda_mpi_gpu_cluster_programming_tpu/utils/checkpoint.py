@@ -1,0 +1,123 @@
+"""Weight/state checkpointing: npz fast-path + orbax for sharded trees.
+
+The reference has no checkpointing at all (SURVEY §5.4) — every version
+re-synthesizes weights in ``main`` — which is why its V1 (srand(time))
+numerics are not comparable across runs. Here weights are first-class
+artifacts: one file serves every tier (XLA reference ops, Pallas, sharded),
+making the cross-tier bit-exactness contract testable from disk.
+
+Two formats:
+
+- **npz** — stdlib-fast flat archive for host-resident trees; keys are
+  '/'-joined pytree paths.
+- **orbax** — for large / sharded trees; restores to the sharding of a
+  provided target tree (multi-host safe).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _flatten(tree: PyTree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(
+            str(p.key) if hasattr(p, "key") else str(p.idx) for p in path
+        )
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> PyTree:
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return _lists_from_int_dicts(tree)
+
+
+def _lists_from_int_dicts(node: PyTree) -> PyTree:
+    """Rebuild list nodes: a dict whose keys are exactly '0'..'n-1' was a
+    sequence before flattening (SequenceKey paths stringify to indices)."""
+    if not isinstance(node, dict):
+        return node
+    node = {k: _lists_from_int_dicts(v) for k, v in node.items()}
+    if node and all(k.isdigit() for k in node):
+        idx = sorted(int(k) for k in node)
+        if idx == list(range(len(node))):
+            return [node[str(i)] for i in idx]
+    return node
+
+
+def save_params_npz(path: str | Path, params: PyTree) -> Path:
+    """Save a (possibly nested-dict) pytree to one .npz file, bit-exact."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, **_flatten(params))
+    return path
+
+
+def load_params_npz(
+    path: str | Path, as_jax: bool = True, like: Optional[PyTree] = None
+) -> PyTree:
+    """Load an npz checkpoint back into the nested tree.
+
+    Without ``like``, dict/list structure is reconstructed from the key
+    paths (tuples and custom nodes come back as lists/dicts). With ``like``
+    — a tree of the original structure (e.g. a freshly-initialized optimizer
+    state) — leaves are restored into *exactly* that structure, so
+    ``tree_map`` against the original never hits a structure mismatch.
+    """
+    with np.load(Path(path)) as archive:
+        flat = {k: archive[k] for k in archive.files}
+    if like is not None:
+        paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for path_keys, _ in paths:
+            key = "/".join(
+                str(p.key) if hasattr(p, "key") else str(p.idx) for p in path_keys
+            )
+            if key not in flat:
+                raise KeyError(f"checkpoint {path} has no leaf {key!r}")
+            leaves.append(flat[key])
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    else:
+        tree = _unflatten(flat)
+    if as_jax:
+        tree = jax.tree_util.tree_map(jax.numpy.asarray, tree)
+    return tree
+
+
+def save_params_orbax(directory: str | Path, params: PyTree) -> Path:
+    """Orbax save (async-capable, sharding-aware on restore)."""
+    import orbax.checkpoint as ocp
+
+    directory = Path(directory).resolve()
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(directory, params, force=True)
+    return directory
+
+
+def load_params_orbax(directory: str | Path, target: Optional[PyTree] = None) -> PyTree:
+    """Orbax restore; with ``target``, restores to its shardings/dtypes."""
+    import orbax.checkpoint as ocp
+
+    directory = Path(directory).resolve()
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is None:
+        return ckptr.restore(directory)
+    restore_args = jax.tree_util.tree_map(
+        lambda leaf: ocp.ArrayRestoreArgs(sharding=getattr(leaf, "sharding", None)),
+        target,
+    )
+    return ckptr.restore(directory, restore_args=restore_args)

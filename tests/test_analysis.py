@@ -1,0 +1,108 @@
+"""Analysis ETL tests: ingest/dedup, views, speedup math, plot, export.
+
+Reference analogue: log_analysis.py's DuckDB pipeline (SURVEY §1 L6, §2.4 H6).
+"""
+
+from pathlib import Path
+
+from cuda_mpi_gpu_cluster_programming_tpu import analysis, harness
+
+
+def _fake_session(tmp_path: Path) -> harness.Session:
+    """Build a session dir with CSV rows mimicking a V1/V2.2 sweep."""
+    session = harness.Session(log_root=tmp_path / "logs", session_id="s1", machine_id="m1")
+    cases = [
+        ("V1 Serial", "v1_jit", 1, 100.0),
+        ("V1 Serial", "v1_jit", 1, 120.0),
+        ("V2.2 ScatterHalo", "v2.2_sharded", 1, 90.0),
+        ("V2.2 ScatterHalo", "v2.2_sharded", 2, 50.0),
+        ("V2.2 ScatterHalo", "v2.2_sharded", 4, 25.0),
+    ]
+    for variant, key, np_, ms in cases:
+        r = harness.CaseResult(variant, key, np_, 1)
+        r.run_status = harness.OK
+        r.time_ms = ms
+        r.shape = "13x13x256"
+        r.first5 = "29.2932 25.9153"
+        session.log_row(r)
+    (session.dir / "run_v1_jit_np1_b1.log").write_text(
+        "Final Output Shape: 13x13x256\n"
+        "AlexNet TPU Forward Pass completed in 100.000 ms (amortized)\n"
+    )
+    return session
+
+
+def test_ingest_views_and_dedup(tmp_path):
+    session = _fake_session(tmp_path)
+    db = tmp_path / "w.sqlite"
+    conn = analysis.connect(db)
+    analysis.cmd_ingest(conn, session.log_root, None)
+    rows = conn.execute("SELECT COUNT(*) FROM summary_runs").fetchone()[0]
+    assert rows == 5
+    assert conn.execute("SELECT COUNT(*) FROM run_logs").fetchone()[0] == 1
+    # perf_runs filters to OK rows with time
+    assert conn.execute("SELECT COUNT(*) FROM perf_runs").fetchone()[0] == 5
+    # best_runs picks min over the two V1 samples
+    best = dict(
+        (tuple(r[:2]), r[3])
+        for r in conn.execute("SELECT variant, np, batch, best_ms FROM best_runs")
+    )
+    assert best[("V1 Serial", 1)] == 100.0
+    # run_stats: mean/stddev/ci over V1 Serial
+    v, np_, b, n, mean, sd, ci = conn.execute(
+        "SELECT * FROM run_stats WHERE variant='V1 Serial'"
+    ).fetchone()
+    assert n == 2 and abs(mean - 110.0) < 1e-9
+    assert abs(sd - 14.142135623730951) < 1e-6
+    # SHA1-incremental re-ingest: unchanged files are skipped, rows not duplicated
+    analysis.cmd_ingest(conn, session.log_root, None)
+    assert conn.execute("SELECT COUNT(*) FROM summary_runs").fetchone()[0] == 5
+    conn.close()
+
+
+def test_speedup_math(tmp_path):
+    session = _fake_session(tmp_path)
+    conn = analysis.connect(tmp_path / "w.sqlite")
+    analysis.cmd_ingest(conn, session.log_root, None)
+    rows = analysis.cmd_speedup(conn, "V1 Serial")
+    by = {(r[0], r[1]): r for r in rows}
+    # S(N) = T1/TN against the best V1 np=1 (100 ms)
+    assert abs(by[("V2.2 ScatterHalo", 4)][4] - 100.0 / 25.0) < 1e-9
+    # E(N) = S/N
+    assert abs(by[("V2.2 ScatterHalo", 4)][5] - 1.0) < 1e-9
+    assert abs(by[("V1 Serial", 1)][4] - 1.0) < 1e-9
+    conn.close()
+
+
+def test_canonical_variant_mapping():
+    assert analysis.canonical_variant("v2.2") == "V2.2 ScatterHalo"
+    assert analysis.canonical_variant("V1 Serial") == "V1 Serial"
+    assert analysis.canonical_variant("V6 TPU Mesh") == "V6 TPU Mesh"  # passthrough
+
+
+def test_plot_and_export(tmp_path):
+    session = _fake_session(tmp_path)
+    db = tmp_path / "w.sqlite"
+    conn = analysis.connect(db)
+    analysis.cmd_ingest(conn, session.log_root, Path("."))
+    analysis.cmd_plot(conn, tmp_path / "plots", "V1 Serial")
+    assert (tmp_path / "plots" / "speedup.png").exists()
+    assert (tmp_path / "plots" / "efficiency.png").exists()
+    analysis.cmd_export(conn, "best_runs", tmp_path / "best.csv", "csv")
+    text = (tmp_path / "best.csv").read_text()
+    assert "V2.2 ScatterHalo" in text
+    analysis.cmd_export(conn, "best_runs", tmp_path / "best.parquet", "parquet")
+    assert (tmp_path / "best.parquet").stat().st_size > 0
+    # source stats were collected from the repo root
+    assert conn.execute("SELECT COUNT(*) FROM source_stats").fetchone()[0] > 10
+    conn.close()
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    session = _fake_session(tmp_path)
+    db = str(tmp_path / "w.sqlite")
+    assert analysis.main(["--db", db, "ingest", "--logs", str(session.log_root), "--repo-root", ""]) == 0
+    assert analysis.main(["--db", db, "stats"]) == 0
+    assert analysis.main(["--db", db, "speedup"]) == 0
+    out = capsys.readouterr().out
+    assert "V2.2 ScatterHalo" in out and "4.00" in out

@@ -31,6 +31,17 @@ def test_classify_generic_fail():
     assert harness.classify(1, "ValueError: something else") == harness.FAIL
 
 
+def test_classify_startup_chatter_does_not_mask_failure():
+    # JAX's benign startup line must not reclassify a later real error.
+    text = (
+        "INFO: Unable to initialize backend 'tpu': not found\n"
+        "Traceback (most recent call last):\n"
+        + "  ...\n" * 10
+        + "ValueError: actual bug in the run\n"
+    )
+    assert harness.classify(1, text) == harness.FAIL
+
+
 def test_parse_run_log_full():
     r = harness.CaseResult("V1 Serial", "v1_jit", 1, 1)
     r.run_status = harness.OK
