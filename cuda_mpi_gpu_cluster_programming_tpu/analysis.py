@@ -70,7 +70,9 @@ class _Stdev:
             self.vals.append(float(v))
 
     def finalize(self) -> Optional[float]:
-        return statistics.stdev(self.vals) if len(self.vals) > 1 else 0.0
+        # NULL for n<2, matching DuckDB's stddev_samp — a single-sample group
+        # must not masquerade as a zero-variance measurement.
+        return statistics.stdev(self.vals) if len(self.vals) > 1 else None
 
 
 def connect(db_path: str | Path) -> sqlite3.Connection:
@@ -188,12 +190,16 @@ def ingest_run_log(conn: sqlite3.Connection, path: Path) -> int:
 
 
 _LANG = {".py": "python", ".sh": "bash", ".cpp": "c++", ".cc": "c++", ".h": "c++", ".hpp": "c++"}
+_SKIP_DIRS = {"node_modules", "__pycache__", "venv", "build", "dist"}
 
 
 def ingest_source_stats(conn: sqlite3.Connection, repo_root: Path) -> int:
     n = 0
     for p in sorted(repo_root.rglob("*")):
-        if p.suffix not in _LANG or not p.is_file() or ".git" in p.parts:
+        if p.suffix not in _LANG or not p.is_file():
+            continue
+        rel_parts = p.relative_to(repo_root).parts
+        if any(part.startswith(".") or part in _SKIP_DIRS for part in rel_parts):
             continue
         loc = sum(1 for _ in open(p, errors="replace"))
         conn.execute(

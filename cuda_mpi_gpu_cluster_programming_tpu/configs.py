@@ -43,6 +43,7 @@ class ExecConfig:
     tier: str  # "reference" (XLA ops) | "pallas"
     strategy: str  # "single" | "replicated" | "halo" | "staged_halo"
     description: str
+    model: str = "blocks12"  # "blocks12" | "alexnet_full"
 
 
 REGISTRY: Dict[str, ExecConfig] = {
@@ -90,18 +91,45 @@ REGISTRY: Dict[str, ExecConfig] = {
             "halo",
             "row-sharded, Pallas per shard, device-to-device ppermute halos over ICI",
         ),
+        # V6 family: the reference's explicit extension task (README.md:19) —
+        # full AlexNet through conv5 + FC6-8 (dims summary.md:29-45).
+        ExecConfig(
+            "v6_full_jit",
+            "V6 AlexNet Full",
+            "reference",
+            "single",
+            "full AlexNet (conv1-5 + FC6-8) single device, XLA ops",
+            model="alexnet_full",
+        ),
+        ExecConfig(
+            "v6_full_pallas",
+            "V6 AlexNet Full Pallas",
+            "pallas",
+            "single",
+            "full AlexNet, Pallas kernels for the spatial part, MXU matmul FC",
+            model="alexnet_full",
+        ),
+        ExecConfig(
+            "v6_full_sharded",
+            "V6 AlexNet Full Sharded",
+            "reference",
+            "halo",
+            "full AlexNet, row-sharded spatial part + replicated FC head",
+            model="alexnet_full",
+        ),
     ]
 }
 
 
 def build_forward(
     exec_cfg: ExecConfig,
-    model_cfg: Blocks12Config = BLOCKS12,
+    model_cfg=None,
     n_shards: int = 1,
     mesh: Optional[jax.sharding.Mesh] = None,
 ) -> Callable:
     """Return a jitted ``(params, x) -> out`` for the given execution config.
 
+    ``model_cfg`` defaults per model family (BLOCKS12 / ALEXNET).
     ``n_shards`` is the TPU analogue of ``mpirun -np N``
     (scripts/common_test_utils.sh:274-276).
     """
@@ -113,6 +141,33 @@ def build_forward(
             f"device_count=N on CPU to fake a mesh)"
         )
 
+    if exec_cfg.model == "alexnet_full":
+        from .models.alexnet_full import ALEXNET, forward_alexnet
+
+        model_cfg = model_cfg or ALEXNET
+        if exec_cfg.strategy == "single":
+            if exec_cfg.tier == "pallas":
+                from .ops.pallas_model import forward_alexnet_pallas
+
+                return jax.jit(lambda p, x: forward_alexnet_pallas(p, x, model_cfg))
+            return jax.jit(lambda p, x: forward_alexnet(p, x, model_cfg))
+        if exec_cfg.strategy in ("halo", "staged_halo"):
+            from .models.alexnet_full import fc_head
+            from .parallel.sharded import build_sharded_forward
+
+            spatial = build_sharded_forward(
+                model_cfg,
+                n_shards,
+                mesh=mesh,
+                tier=exec_cfg.tier,
+                staged=(exec_cfg.strategy == "staged_halo"),
+            )
+            # Row-sharded feature extractor; FC head on the gathered features
+            # (replicated — the 6x6x256 activations are tiny next to conv1's).
+            return jax.jit(lambda p, x: fc_head(p, spatial(p, x), model_cfg))
+        raise ValueError(f"strategy {exec_cfg.strategy!r} not supported for alexnet_full")
+
+    model_cfg = model_cfg or BLOCKS12
     if exec_cfg.strategy == "single":
         if exec_cfg.tier == "pallas":
             from .ops.pallas_model import forward_blocks12_pallas

@@ -65,25 +65,28 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, ho: int, wo: int, relu:
     cs = x_ref.shape[-1]
     k = w_ref.shape[-1]
 
-    # fori_loop (not Python unroll) so only one window slice is live at a
-    # time — unrolling kept all fq^2 windows in scoped VMEM and OOMed; the
-    # windows are dynamic pl.ds slices of the *ref* (dynamic_slice on loaded
-    # values has no Mosaic lowering). Fixed tap-group order => deterministic
-    # fp32 accumulation (SURVEY §7.3).
-    def tap(idx, acc):
-        qh, qw = idx // fq, idx % fq
-        win = x_ref[0, pl.ds(qh, ho), pl.ds(qw, wo), :]
-        wtap = w_ref[pl.ds(qh, 1), pl.ds(qw, 1), :, :]
-        # HIGHEST: true fp32 MACs on the MXU; the default would round the
-        # operands to bf16 and miss the reference numerics by ~1e-3 rel.
-        return acc + jnp.dot(
-            win.reshape(ho * wo, cs),
-            wtap.reshape(cs, k),
-            preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST,
-        )
+    # fori_loop over the H tap (dim 1 is untiled, so a dynamic start is
+    # always legal); the W taps are a static Python unroll — W is the
+    # sublane-tiled dim, where Mosaic requires dynamic starts to be provably
+    # 8-aligned (fails for C>=128 lane-exact layouts, e.g. conv3's C=256).
+    # Only one fori body is live at a time, so at most fq windows coexist in
+    # VMEM (full fq^2 unrolling OOMed). Fixed (qh outer, qw inner) order =>
+    # deterministic fp32 accumulation (SURVEY §7.3).
+    def tap_row(qh, acc):
+        for qw in range(fq):
+            win = x_ref[0, pl.ds(qh, ho), qw : qw + wo, :]
+            wtap = w_ref[pl.ds(qh, 1), qw, :, :]
+            # HIGHEST: true fp32 MACs on the MXU; the default would round the
+            # operands to bf16 and miss the reference numerics by ~1e-3 rel.
+            acc = acc + jnp.dot(
+                win.reshape(ho * wo, cs),
+                wtap.reshape(cs, k),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            )
+        return acc
 
-    acc = lax.fori_loop(0, fq * fq, tap, jnp.zeros((ho * wo, k), jnp.float32))
+    acc = lax.fori_loop(0, fq, tap_row, jnp.zeros((ho * wo, k), jnp.float32))
     out = acc.reshape(ho, wo, k) + b_ref[:].astype(jnp.float32)
     if relu:
         out = jnp.maximum(out, 0.0)

@@ -29,3 +29,34 @@ def forward_blocks12_pallas(params, x: jax.Array, cfg: Blocks12Config = BLOCKS12
         x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
     )
     return x
+
+
+def forward_alexnet_pallas(params, x: jax.Array, cfg=None) -> jax.Array:
+    """Full AlexNet on the Pallas tier: chain-driven spatial part (fused
+    conv+bias+ReLU launches), then the shared MXU-matmul FC head."""
+    from ..models.alexnet import ConvSpec, LrnSpec, PoolSpec
+    from ..models.alexnet_full import ALEXNET, fc_head
+
+    cfg = cfg or ALEXNET
+    for name, spec in cfg.layer_chain():
+        if isinstance(spec, ConvSpec):
+            x = pk.conv2d_pallas(
+                x,
+                params[name]["w"],
+                params[name]["b"],
+                stride=spec.stride,
+                padding=spec.padding,
+                relu=True,
+            )
+        elif isinstance(spec, PoolSpec):
+            x = pk.maxpool_pallas(x, window=spec.window, stride=spec.stride)
+        elif isinstance(spec, LrnSpec):
+            x = pk.lrn_pallas(
+                x,
+                size=spec.size,
+                alpha=spec.alpha,
+                beta=spec.beta,
+                k=spec.k,
+                alpha_over_size=spec.alpha_over_size,
+            )
+    return fc_head(params, x, cfg)

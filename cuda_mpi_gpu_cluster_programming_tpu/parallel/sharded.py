@@ -52,7 +52,7 @@ def _apply_spatial(
     lp: LayerPlan,
     x: jax.Array,
     params,
-    cfg: Blocks12Config,
+    spec,
     axis_name: str,
     n: int,
     conv_fn: Callable,
@@ -68,11 +68,9 @@ def _apply_spatial(
     s0 = i * lp.s0_coef + lp.s0_const
     win = lax.dynamic_slice_in_dim(padded, s0, lp.win_rows, axis=1)
     if lp.kind == "conv":
-        spec = cfg.conv1 if lp.name == "conv1" else cfg.conv2
         w, b = params[lp.name]["w"], params[lp.name]["b"]
         out = conv_fn(win, w, b, stride=spec.stride, padding_w=spec.padding)
     else:
-        spec = cfg.pool1 if lp.name == "pool1" else cfg.pool2
         out = pool_fn(win, window=spec.window, stride=spec.stride)
     # out has exactly b_out rows: (win_rows - F)//S + 1 == b_out
     mask = _row_mask(lp.b_out, lp.b_out, lp.l_out, axis_name, out.dtype)
@@ -118,26 +116,27 @@ def build_sharded_forward(
     else:
         conv_fn, pool_fn = _conv_hvalid, _pool_hvalid
 
-    lrn = model_cfg.lrn2
+    specs = dict(model_cfg.layer_chain())
 
     def shard_body(params, xb):
         # xb: (N, b0, W, C) — this shard's rows (zero-padded past H)
         cur = xb
         for lp in plan.layers:
+            spec = specs[lp.name]
             if lp.kind == "pointwise":
                 cur = ops.lrn(
                     cur,
-                    size=lrn.size,
-                    alpha=lrn.alpha,
-                    beta=lrn.beta,
-                    k=lrn.k,
-                    alpha_over_size=lrn.alpha_over_size,
+                    size=spec.size,
+                    alpha=spec.alpha,
+                    beta=spec.beta,
+                    k=spec.k,
+                    alpha_over_size=spec.alpha_over_size,
                 )
             else:
                 cur = _apply_spatial(
-                    lp, cur, params, model_cfg, AXIS, n, conv_fn, pool_fn, staged
+                    lp, cur, params, spec, AXIS, n, conv_fn, pool_fn, staged
                 )
-                cur = ops.relu(cur) if lp.name in ("conv1", "conv2") else cur
+                cur = ops.relu(cur) if lp.kind == "conv" else cur
         return cur
 
     sharded = shard_map(

@@ -56,7 +56,7 @@ def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
 
     from .configs import REGISTRY, build_forward
-    from .models.alexnet import BLOCKS12, output_shape
+    from .models.alexnet import BLOCKS12
     from .models.init import (
         deterministic_input,
         init_params_deterministic,
@@ -75,34 +75,51 @@ def main(argv=None) -> int:
         return 2
     exec_cfg = REGISTRY[args.config]
 
-    model_cfg = dataclasses.replace(
+    blocks_cfg = dataclasses.replace(
         BLOCKS12,
         in_height=args.height,
         in_width=args.width,
         lrn2=dataclasses.replace(BLOCKS12.lrn2, alpha_over_size=(args.lrn_form == "cpu")),
     )
+    if exec_cfg.model == "alexnet_full":
+        from .models.alexnet_full import AlexNetConfig
+
+        model_cfg = AlexNetConfig(blocks12=blocks_cfg)
+    else:
+        model_cfg = blocks_cfg
 
     print(f"--- AlexNet TPU {exec_cfg.version_name} [{exec_cfg.key}] "
           f"(shards={args.shards}, batch={args.batch}) ---")
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind} "
           f"({jax.default_backend()})")
 
+    if exec_cfg.model == "alexnet_full":
+        from .models.alexnet_full import init_full_deterministic, init_full_random
+
+        init_det, init_rnd = init_full_deterministic, init_full_random
+    else:
+        init_det, init_rnd = init_params_deterministic, init_params_random
+    input_cfg = blocks_cfg  # inputs depend only on the Blocks 1-2 input dims
     if args.params:
         from .utils.checkpoint import load_params_npz
 
         params = load_params_npz(args.params)
         print(f"Loaded params from {args.params}")
-        x = deterministic_input(args.batch, model_cfg) if args.init == "deterministic" else (
-            random_input(jax.random.PRNGKey(args.seed), args.batch, model_cfg)
-        )
+        if args.init == "deterministic":
+            x = deterministic_input(args.batch, input_cfg)
+        else:
+            # Same kx derivation as the init path, so --params w.npz --seed S
+            # reproduces the exact inputs of the run that saved w.npz.
+            _, kx = jax.random.split(jax.random.PRNGKey(args.seed))
+            x = random_input(kx, args.batch, input_cfg)
     elif args.init == "deterministic":
-        params = init_params_deterministic(model_cfg)
-        x = deterministic_input(args.batch, model_cfg)
+        params = init_det(model_cfg)
+        x = deterministic_input(args.batch, input_cfg)
     else:
         key = jax.random.PRNGKey(args.seed)
         kp, kx = jax.random.split(key)
-        params = init_params_random(kp, model_cfg)
-        x = random_input(kx, args.batch, model_cfg)
+        params = init_rnd(kp, model_cfg)
+        x = random_input(kx, args.batch, input_cfg)
     if args.save_params:
         from .utils.checkpoint import save_params_npz
 
@@ -123,11 +140,11 @@ def main(argv=None) -> int:
     )
     out = np.asarray(fwd(params, x))
 
-    h, w, c = output_shape(model_cfg)
+    shape_str = "x".join(str(d) for d in out.shape[1:])
     flat = out[0].reshape(-1)
     first10 = " ".join(f"{v:.4f}" for v in flat[:10])
     print(f"Compile time: {compile_ms:.1f} ms")
-    print(f"Final Output Shape: {h}x{w}x{c}")
+    print(f"Final Output Shape: {shape_str}")
     print(f"Final Output (first 10 values): {first10}")
     print(
         f"AlexNet TPU Forward Pass completed in {per_pass_ms:.3f} ms "

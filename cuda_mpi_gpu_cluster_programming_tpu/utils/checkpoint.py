@@ -25,13 +25,19 @@ import numpy as np
 PyTree = Any
 
 
+def _key_str(entry) -> str:
+    """One path entry -> string: DictKey(.key), SequenceKey(.idx),
+    GetAttrKey(.name) — covers dicts, sequences, and registered dataclasses."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
 def _flatten(tree: PyTree) -> Dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(
-            str(p.key) if hasattr(p, "key") else str(p.idx) for p in path
-        )
-        flat[key] = np.asarray(leaf)
+        flat["/".join(_key_str(p) for p in path)] = np.asarray(leaf)
     return flat
 
 
@@ -84,9 +90,7 @@ def load_params_npz(
         paths, treedef = jax.tree_util.tree_flatten_with_path(like)
         leaves = []
         for path_keys, _ in paths:
-            key = "/".join(
-                str(p.key) if hasattr(p, "key") else str(p.idx) for p in path_keys
-            )
+            key = "/".join(_key_str(p) for p in path_keys)
             if key not in flat:
                 raise KeyError(f"checkpoint {path} has no leaf {key!r}")
             leaves.append(flat[key])

@@ -1,0 +1,133 @@
+"""Full-AlexNet tests: dims, blocks12-prefix equivalence, tier equivalence,
+sharded spatial part, softmax head.
+
+The extension task of README.md:19 with dims from summary.md:29-45.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12, forward_blocks12
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet_full import (
+    ALEXNET,
+    AlexNetConfig,
+    forward_alexnet,
+    forward_spatial,
+    init_full_deterministic,
+    init_full_random,
+    predict,
+    spatial_output_shape,
+)
+
+# Small config for CPU speed: 99 -> conv1 23 -> pool1 11 -> conv2 11 ->
+# pool2 5 -> conv3/4/5 5 -> pool5 2.
+SMALL = AlexNetConfig(
+    blocks12=dataclasses.replace(BLOCKS12, in_height=99, in_width=99),
+    fc6=64,
+    fc7=32,
+    num_classes=10,
+)
+
+
+def _x(batch=1, cfg=SMALL):
+    return jax.random.uniform(
+        jax.random.PRNGKey(0), (batch, cfg.in_height, cfg.in_width, cfg.in_channels)
+    )
+
+
+def test_spatial_dims_match_reference_table():
+    # summary.md:29-45 dim chain: 227 -> ... -> 6x6x256
+    assert spatial_output_shape(ALEXNET) == (6, 6, 256)
+    assert spatial_output_shape(SMALL) == (2, 2, 256)
+
+
+def test_full_param_shapes():
+    params = init_full_deterministic(ALEXNET)
+    assert params["conv3"]["w"].shape == (3, 3, 256, 384)
+    assert params["conv4"]["w"].shape == (3, 3, 384, 384)
+    assert params["conv5"]["w"].shape == (3, 3, 384, 256)
+    assert params["fc6"]["w"].shape == (6 * 6 * 256, 4096)
+    assert params["fc8"]["w"].shape == (4096, 1000)
+
+
+def test_blocks12_prefix_bit_identical():
+    """forward_spatial == conv3..pool5 applied on top of forward_blocks12 —
+    i.e. the Blocks 1-2 prefix keeps the reference's exact semantics and
+    golden oracle."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import reference as ops
+
+    full_params = init_full_random(jax.random.PRNGKey(1), SMALL)
+    x = _x()
+    b12_params = {"conv1": full_params["conv1"], "conv2": full_params["conv2"]}
+    want = forward_blocks12(b12_params, x, SMALL.blocks12)
+    for name, spec in (("conv3", SMALL.conv3), ("conv4", SMALL.conv4), ("conv5", SMALL.conv5)):
+        want = ops.relu(
+            ops.conv2d(
+                want,
+                full_params[name]["w"],
+                full_params[name]["b"],
+                stride=spec.stride,
+                padding=spec.padding,
+            )
+        )
+    want = ops.maxpool(want, window=SMALL.pool5.window, stride=SMALL.pool5.stride)
+    got = forward_spatial(full_params, x, SMALL)
+    assert jnp.array_equal(got, want)
+
+
+def test_logits_shape_and_softmax():
+    params = init_full_random(jax.random.PRNGKey(2), SMALL)
+    logits = jax.jit(lambda p, x: forward_alexnet(p, x, SMALL))(params, _x(3))
+    assert logits.shape == (3, 10)
+    probs = predict(params, _x(3), SMALL)
+    np.testing.assert_allclose(np.asarray(probs.sum(axis=-1)), np.ones(3), rtol=1e-5)
+
+
+def test_dropout_train_vs_eval():
+    params = init_full_random(jax.random.PRNGKey(3), SMALL)
+    x = _x()
+    eval_logits = forward_alexnet(params, x, SMALL)
+    train_logits = forward_alexnet(params, x, SMALL, dropout_key=jax.random.PRNGKey(0))
+    assert not jnp.allclose(eval_logits, train_logits)  # dropout active
+    eval2 = forward_alexnet(params, x, SMALL)
+    assert jnp.array_equal(eval_logits, eval2)  # eval deterministic
+
+
+def test_pallas_tier_matches_reference_tier():
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_model import forward_alexnet_pallas
+
+    params = init_full_random(jax.random.PRNGKey(4), SMALL)
+    x = _x(2)
+    want = jax.jit(lambda p, x: forward_alexnet(p, x, SMALL))(params, x)
+    got = jax.jit(lambda p, x: forward_alexnet_pallas(p, x, SMALL))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-4)
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_sharded_full_matches_single(n_shards):
+    """Row-sharded spatial + replicated FC == single-device full pass, even
+    when late layers leave some shards owning zero rows."""
+    cfg = REGISTRY["v6_full_sharded"]
+    params = init_full_random(jax.random.PRNGKey(5), SMALL)
+    x = _x(2)
+    want = jax.jit(lambda p, x: forward_alexnet(p, x, SMALL))(params, x)
+    fwd = build_forward(cfg, SMALL, n_shards=n_shards)
+    got = fwd(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-4)
+
+
+def test_full_deterministic_cross_tier_exact():
+    """Deterministic init: pallas and reference tiers agree to float tolerance
+    on the full net (the reference never achieved V3==V1 comparability)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_model import forward_alexnet_pallas
+
+    params = init_full_deterministic(SMALL)
+    x = jnp.ones((1, SMALL.in_height, SMALL.in_width, SMALL.in_channels))
+    a = jax.jit(lambda p, x: forward_alexnet(p, x, SMALL))(params, x)
+    b = jax.jit(lambda p, x: forward_alexnet_pallas(p, x, SMALL))(params, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)

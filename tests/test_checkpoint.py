@@ -66,3 +66,22 @@ def test_orbax_roundtrip(tmp_path):
     restored = ckpt.load_params_orbax(d, target=params)
     for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(restored)):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_npz_optax_state_roundtrip(tmp_path):
+    """Real optimizer state (namedtuple/dataclass nodes) saves and restores
+    with like= into the exact original structure."""
+    import optax
+
+    params = init_params_random(jax.random.PRNGKey(2))
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    path = ckpt.save_params_npz(tmp_path / "opt.npz", state)
+    template = opt.init(params)  # fresh state of identical structure
+    restored = ckpt.load_params_npz(path, like=template)
+    assert jax.tree_util.tree_structure(restored) == jax.tree_util.tree_structure(state)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state,
+        restored,
+    )

@@ -12,8 +12,9 @@ from cuda_mpi_gpu_cluster_programming_tpu.models import (
 
 def test_registry_covers_reference_stages():
     names = {c.version_name for c in REGISTRY.values()}
-    # the canonical analysis names of the reference's five stages + V5
-    assert names == {
+    # the canonical analysis names of the reference's five stages + V5 must
+    # all be present (the V6 full-AlexNet family extends the set).
+    assert names >= {
         "V1 Serial",
         "V2.1 BroadcastAll",
         "V2.2 ScatterHalo",
