@@ -1,0 +1,1 @@
+"""Worked distributed-programming examples (the homeworks/ analogue)."""

@@ -22,6 +22,7 @@ keyed — inference is deterministic with ``dropout_key=None``.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -188,7 +189,7 @@ def init_full_random(key: jax.Array, cfg: AlexNetConfig = ALEXNET, dtype=jnp.flo
     keys = jax.random.split(key, len(shapes))
     params: Params = {}
     for k, (name, (ws, bs)) in zip(keys, shapes.items()):
-        fan_in = int(jnp.prod(jnp.array(ws[:-1])))
+        fan_in = math.prod(ws[:-1])
         params[name] = {
             "w": jax.random.normal(k, ws, dtype) * (2.0 / fan_in) ** 0.5,
             "b": jnp.full(bs, 0.1, dtype),

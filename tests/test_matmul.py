@@ -1,0 +1,73 @@
+"""Distributed matmul example (hw1 analogue) tests.
+
+The reference's only programmatic checker is hw1's parallel-vs-serial epsilon
+compare, tol 1e-6 (homeworks/hw1/src/template.c:149-176,220-238); its test
+runner sweeps np in 1..8 x n in {128..2048} skipping non-divisible combos
+(scripts/test_hw.sh:8-10,113-147). Same matrix here, on the 8-device CPU mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.examples.matmul import (
+    MAXDIM,
+    STRATEGIES,
+    check_result,
+    init_data,
+    mat_mult_distributed,
+    mat_mult_serial,
+    validate_n,
+)
+
+
+@pytest.fixture(scope="module")
+def ab():
+    ka, kb = jax.random.split(jax.random.PRNGKey(0))
+    n = 128
+    return init_data(ka, n), init_data(kb, n)
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+@pytest.mark.parametrize("np_", [1, 2, 4, 8])
+def test_distributed_matches_serial(ab, strategy, np_):
+    a, b = ab
+    d = mat_mult_serial(a, b)
+    c = mat_mult_distributed(a, b, np_, strategy)
+    # Integer-valued inputs 0-9 make fp32 exact: bitwise equality, not just eps.
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+    assert not check_result(c, d)
+
+
+def test_check_result_detects_mismatch(ab):
+    a, b = ab
+    d = mat_mult_serial(a, b)
+    c = d.at[3, 5].add(1e-3)
+    assert check_result(c, d)
+
+
+def test_validate_n_contract():
+    assert validate_n(64, 4) == 64
+    assert validate_n(1 << 13, 1) == MAXDIM  # clamp (template.c:56-63)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_n(100, 1)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_n(-4, 1)
+    with pytest.raises(ValueError, match="divisible"):
+        validate_n(64, 3)  # the test_hw.sh skip rule, surfaced as an error
+
+
+def test_cli_smoke(capsys):
+    from cuda_mpi_gpu_cluster_programming_tpu.examples.matmul import main
+
+    assert main(["64", "--shards", "4", "--strategy", "ring"]) == 0
+    out = capsys.readouterr().out
+    assert "Test: PASSED" in out
+    assert "num_procs=4 n=64 my_work=16" in out
+
+
+def test_cli_rejects_bad_n(capsys):
+    from cuda_mpi_gpu_cluster_programming_tpu.examples.matmul import main
+
+    assert main(["100"]) == 1
+    assert "Error" in capsys.readouterr().out
