@@ -163,7 +163,8 @@ def mat_mult_distributed(
     if strategy not in _IMPLS:
         raise ValueError(f"unknown strategy {strategy!r}; choose from {STRATEGIES}")
     mesh = mesh or make_mesh(n_shards)
-    return _IMPLS[strategy](a, b, mesh)
+    # The shard axis is the innermost mesh axis, whatever the caller named it.
+    return _IMPLS[strategy](a, b, mesh, axis=mesh.axis_names[-1])
 
 
 def check_result(c: jax.Array, d: jax.Array, tolerance: float = TOLERANCE) -> bool:

@@ -25,6 +25,7 @@ import argparse
 import csv
 import dataclasses
 import datetime
+import json
 import os
 import platform
 import re
@@ -33,6 +34,8 @@ import sys
 import time
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
+
+from .utils.env_info import cpu_subprocess_env
 
 # 20-column CSV schema (analogue of 0_run_final_project.sh:41).
 CSV_COLUMNS = [
@@ -216,6 +219,15 @@ class Session:
         self.csv_path = self.dir / "summary.csv"
         with open(self.csv_path, "w", newline="") as f:
             csv.writer(f).writerow(CSV_COLUMNS)
+        # Environment dump next to the CSV (the pc_v4_environment_info.txt
+        # analogue) so analysis can attribute numbers to toolchains. No
+        # device probe here — the harness process must not initialize a
+        # backend the run subprocesses will claim.
+        from .utils.env_info import collect
+
+        (self.dir / "env.json").write_text(
+            json.dumps(collect(probe_devices=False), indent=2) + "\n"
+        )
 
     def log_row(self, r: CaseResult) -> None:
         with open(self.csv_path, "a", newline="") as f:
@@ -277,16 +289,12 @@ def run_case(
         str(batch),
         *extra_args,
     ]
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)  # breaks the TPU plugin (see verify skill)
     if fake_devices:
         # The --oversubscribe analogue: N virtual host devices on CPU.
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = env.get("XLA_FLAGS", "")
-        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
-        env["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={fake_devices}"
-        ).strip()
+        env = cpu_subprocess_env(fake_devices)
+    else:
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)  # breaks the TPU plugin (see verify skill)
 
     t0 = time.perf_counter()
     try:

@@ -55,6 +55,13 @@ def make_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
 
+    # Join a multi-host cluster when launched by parallel.distributed's
+    # launch_plan/launch_local (no-op otherwise) — must happen before any
+    # backend use.
+    from .parallel.distributed import maybe_initialize_from_env
+
+    maybe_initialize_from_env()
+
     from .configs import REGISTRY, build_forward
     from .models.alexnet import BLOCKS12
     from .models.init import (

@@ -1,0 +1,209 @@
+"""Experiment scaffolding, test sweep, and submission packaging CLI.
+
+Python replacement for the reference's homework toolchain (SURVEY H8-H10,
+H12):
+
+- ``scaffold new N``     ↔ ``scripts/scaffold_hw.sh`` — generates
+  ``experiments/hwN/`` (src/template.py + summary.md) from the package's
+  ``templates/`` directory, refusing to overwrite existing work
+  (scaffold_hw.sh checks per-file existence). There is no Makefile/CMake on
+  TPU — the "build" is jit compilation, so the generated artifact is a
+  runnable Python entry named ``template`` like the course's required
+  executable name (homeworks/hw1/Makefile:18-19).
+- ``scaffold test N``    ↔ ``scripts/test_hw.sh`` — sweeps
+  np in 1..8 x n in {128..2048} with a 30 s per-run timeout (:8-10,124),
+  skipping non-divisible (n, np) combos (:113-147), tri-state
+  PASSED/FAILED/TIMEOUT summary with exit code 0/1/2 (:160-180). Runs each
+  case on an np-device virtual CPU mesh (the ``mpirun --oversubscribe``
+  analogue).
+- ``scaffold package N last first`` ↔ ``scripts/package_hw.sh`` — stages
+  ``hwN-<last>-<first>/`` (lowercased, :11-13) with the source + summary and
+  tars it to ``hwN-<last>-<first>.tgz`` (:17-96).
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+import tarfile
+import tempfile
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from .utils.env_info import cpu_subprocess_env
+
+TEMPLATES_DIR = Path(__file__).resolve().parent / "templates"
+DEFAULT_EXPERIMENTS_ROOT = "experiments"
+
+# test_hw.sh:8-10 sweep matrix and timeout.
+PROBLEM_SIZES = (128, 256, 512, 1024, 2048)
+PROCESS_COUNTS = (1, 2, 3, 4, 5, 6, 7, 8)
+TIMEOUT_S = 30.0
+
+PASSED, FAILED, TIMEOUT, SKIPPED = "PASSED", "FAILED", "TIMEOUT", "SKIPPED"
+
+
+def hw_dir(root: Path, hw_num: int) -> Path:
+    return root / f"hw{hw_num}"
+
+
+def cmd_new(root: Path, hw_num: int, force: bool = False) -> Path:
+    """Generate experiments/hwN from templates (scaffold_hw.sh analogue)."""
+    target = hw_dir(root, hw_num)
+    src_dir = target / "src"
+    src_dir.mkdir(parents=True, exist_ok=True)
+    plan = [
+        (TEMPLATES_DIR / "template.py.template", src_dir / "template.py"),
+        (TEMPLATES_DIR / "summary.md.template", target / "summary.md"),
+    ]
+    for tmpl, dest in plan:
+        if dest.exists() and not force:
+            print(f"skip (exists): {dest}")
+            continue
+        dest.write_text(tmpl.read_text().replace("{HW_NUM}", str(hw_num)))
+        print(f"created: {dest}")
+    return target
+
+
+def run_case(
+    entry: Path, n: int, np_: int, timeout_s: float = TIMEOUT_S
+) -> Tuple[str, float, str]:
+    """One sweep case on an np-device virtual CPU mesh. Returns
+    (status, wall_s, detail)."""
+    if n % np_ != 0:
+        return SKIPPED, 0.0, f"n%np={n % np_}"
+    env = cpu_subprocess_env(np_)
+    cmd = [sys.executable, str(entry), str(n), "--shards", str(np_)]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, env=env
+        )
+    except subprocess.TimeoutExpired:
+        return TIMEOUT, time.perf_counter() - t0, f"timeout {timeout_s:.0f}s"
+    wall = time.perf_counter() - t0
+    out = proc.stdout + proc.stderr
+    if proc.returncode == 0 and "Test: PASSED" in out:
+        return PASSED, wall, ""
+    tail = [ln for ln in out.strip().splitlines() if ln.strip()]
+    return FAILED, wall, (tail[-1][:100] if tail else f"exit {proc.returncode}")
+
+
+def cmd_test(
+    root: Path,
+    hw_num: int,
+    sizes: Tuple[int, ...] = PROBLEM_SIZES,
+    np_counts: Tuple[int, ...] = PROCESS_COUNTS,
+    timeout_s: float = TIMEOUT_S,
+) -> int:
+    """Sweep runner (test_hw.sh analogue). Exit 0/1/2 = pass/fail/timeout."""
+    target = hw_dir(root, hw_num)
+    entry = target / "src" / "template.py"
+    if not entry.exists():
+        print(f"Error: '{entry}' not found. Did you run 'scaffold new {hw_num}'?")
+        return 1
+    print(f"--- Testing experiment hw{hw_num} ---")
+    results: List[Tuple[int, int, str, float, str]] = []
+    worst = 0
+    for np_ in np_counts:
+        for n in sizes:
+            status, wall, detail = run_case(entry, n, np_, timeout_s)
+            results.append((np_, n, status, wall, detail))
+            mark = {PASSED: "✓", FAILED: "✗", TIMEOUT: "⏱", SKIPPED: "-"}[status]
+            line = f"[np={np_} n={n}] {mark} {status}"
+            if status == PASSED:
+                line += f" ({wall:.2f}s)"
+            elif detail:
+                line += f" ({detail})"
+            print(line)
+            worst = max(worst, {FAILED: 1, TIMEOUT: 2}.get(status, 0))
+    n_pass = sum(1 for r in results if r[2] == PASSED)
+    n_skip = sum(1 for r in results if r[2] == SKIPPED)
+    print(
+        f"--- hw{hw_num}: {n_pass} passed, "
+        f"{sum(1 for r in results if r[2] == FAILED)} failed, "
+        f"{sum(1 for r in results if r[2] == TIMEOUT)} timed out, "
+        f"{n_skip} skipped ---"
+    )
+    return worst
+
+
+def cmd_package(
+    root: Path, hw_num: int, lastname: str, firstname: str, out_dir: Optional[Path] = None
+) -> Path:
+    """Build hwN-<last>-<first>.tgz (package_hw.sh analogue)."""
+    target = hw_dir(root, hw_num)
+    entry = target / "src" / "template.py"
+    summary = target / "summary.md"
+    if not target.is_dir():
+        raise FileNotFoundError(f"experiment directory '{target}' not found")
+    if not entry.exists():
+        raise FileNotFoundError(f"required source file '{entry}' not found")
+    sub_name = f"hw{hw_num}-{lastname.lower()}-{firstname.lower()}"
+    out_dir = out_dir or target
+    archive = out_dir / f"{sub_name}.tgz"
+    with tempfile.TemporaryDirectory() as td:
+        stage = Path(td) / sub_name
+        (stage / "src").mkdir(parents=True)
+        shutil.copy2(entry, stage / "src" / "template.py")
+        if summary.exists():
+            shutil.copy2(summary, stage / "summary.md")
+        with tarfile.open(archive, "w:gz") as tf:
+            tf.add(stage, arcname=sub_name)
+    print(f"packaged: {archive}")
+    return archive
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cuda_mpi_gpu_cluster_programming_tpu.scaffold")
+    p.add_argument(
+        "--root", default=DEFAULT_EXPERIMENTS_ROOT, help="experiments root directory"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    p_new = sub.add_parser("new", help="generate a new experiment from templates")
+    p_new.add_argument("hw_num", type=int)
+    p_new.add_argument("--force", action="store_true", help="overwrite existing files")
+
+    p_test = sub.add_parser("test", help="np x size sweep with timeout")
+    p_test.add_argument("hw_num", type=int)
+    p_test.add_argument("--sizes", default=",".join(map(str, PROBLEM_SIZES)))
+    p_test.add_argument("--np-counts", default=",".join(map(str, PROCESS_COUNTS)))
+    p_test.add_argument("--timeout", type=float, default=TIMEOUT_S)
+
+    p_pkg = sub.add_parser("package", help="create submission .tgz")
+    p_pkg.add_argument("hw_num", type=int)
+    p_pkg.add_argument("lastname")
+    p_pkg.add_argument("firstname")
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    root = Path(args.root)
+    if args.command == "new":
+        cmd_new(root, args.hw_num, force=args.force)
+        return 0
+    if args.command == "test":
+        return cmd_test(
+            root,
+            args.hw_num,
+            sizes=tuple(int(s) for s in args.sizes.split(",")),
+            np_counts=tuple(int(s) for s in args.np_counts.split(",")),
+            timeout_s=args.timeout,
+        )
+    if args.command == "package":
+        try:
+            cmd_package(root, args.hw_num, args.lastname, args.firstname)
+        except FileNotFoundError as e:
+            print(f"Error: {e}")
+            return 1
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,103 @@
+"""Environment capture: the pc_v4_environment_info.txt / shell.nix analogue.
+
+The reference pins its toolchain two ways: a nix shell fixing GCC/CUDA/
+Open MPI versions (shell.nix:2-36) and a checked-in environment dump from the
+dev machine (pc_v4_environment_info.txt — GCC 13.3, Open MPI 4.1.6, CUDA
+12.8). Here the equivalents are ``requirements.txt`` (the pin) and this
+module (the dump): a machine-readable record of the Python/JAX/TPU toolchain
+a benchmark session ran under, written next to the session CSV so analysis
+can attribute numbers to environments.
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+import json
+import os
+import platform
+import sys
+from typing import Dict
+
+PACKAGES = (
+    "jax",
+    "jaxlib",
+    "libtpu",
+    "flax",
+    "optax",
+    "orbax-checkpoint",
+    "chex",
+    "einops",
+    "numpy",
+    "pytest",
+)
+
+
+def collect(probe_devices: bool = True) -> Dict[str, object]:
+    info: Dict[str, object] = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "machine": platform.node() or "unknown",
+        "packages": {},
+        "env": {
+            k: os.environ.get(k, "")
+            for k in ("JAX_PLATFORMS", "XLA_FLAGS", "LIBTPU_INIT_ARGS")
+            if os.environ.get(k)
+        },
+    }
+    for pkg in PACKAGES:
+        try:
+            info["packages"][pkg] = importlib.metadata.version(pkg)
+        except importlib.metadata.PackageNotFoundError:
+            info["packages"][pkg] = None
+    if probe_devices:
+        # The nvidia-smi-query analogue (common_test_utils.sh:30-48): record
+        # what accelerators this process actually sees.
+        try:
+            import jax
+
+            info["backend"] = jax.default_backend()
+            info["device_count"] = jax.device_count()
+            info["devices"] = [d.device_kind for d in jax.devices()]
+            info["process_count"] = jax.process_count()
+        except Exception as e:  # device probe must never fail the capture
+            info["backend_error"] = f"{type(e).__name__}: {e}"
+    return info
+
+
+def cpu_subprocess_env(n_devices: int) -> Dict[str, str]:
+    """Environment for a subprocess that must run on N virtual CPU devices
+    (the ``mpirun --oversubscribe`` analogue). Single home for the TPU-plugin
+    gotchas: PYTHONPATH (even empty) breaks the axon plugin, the ambient
+    sitecustomize registers the TPU unless PALLAS_AXON_POOL_IPS is blanked,
+    and any prior device-count flag must be spliced out of XLA_FLAGS."""
+    import re
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", "")
+    )
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    return env
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="cuda_mpi_gpu_cluster_programming_tpu.utils.env_info")
+    p.add_argument("--out", help="also write the JSON dump to this path")
+    p.add_argument("--no-devices", action="store_true", help="skip the device probe")
+    args = p.parse_args(argv)
+    info = collect(probe_devices=not args.no_devices)
+    text = json.dumps(info, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,37 @@
+"""Environment capture tests (pc_v4_environment_info.txt analogue)."""
+
+import json
+from pathlib import Path
+
+from cuda_mpi_gpu_cluster_programming_tpu.utils.env_info import collect, main
+
+REQUIREMENTS = Path(__file__).resolve().parents[1] / "requirements.txt"
+
+
+def test_collect_pins_match_requirements():
+    info = collect(probe_devices=False)
+    assert info["packages"]["jax"] is not None
+    with open(REQUIREMENTS) as f:
+        pins = dict(
+            line.strip().split("==")
+            for line in f
+            if "==" in line and not line.startswith("#")
+        )
+    for pkg, pinned in pins.items():
+        if pkg in ("pytest",):  # test-only tooling may drift
+            continue
+        assert info["packages"].get(pkg) == pinned, f"{pkg} drifted from requirements.txt"
+
+
+def test_collect_device_probe():
+    info = collect(probe_devices=True)
+    assert info["device_count"] == 8  # conftest virtual mesh
+    assert info["backend"] == "cpu"
+
+
+def test_cli_writes_json(tmp_path, capsys):
+    out = tmp_path / "env.json"
+    assert main(["--out", str(out), "--no-devices"]) == 0
+    data = json.loads(out.read_text())
+    assert "packages" in data and "python" in data
+    assert json.loads(capsys.readouterr().out) == data

@@ -1,0 +1,81 @@
+"""Scaffolding CLI tests (scaffold_hw.sh / test_hw.sh / package_hw.sh analogues).
+
+The generated template must be runnable as-is and self-verify (the course
+templates compile as-is); the sweep runner must implement test_hw.sh's
+skip/timeout/exit-code semantics (:8-10,113-180); packaging must follow the
+hwN-last-first naming (package_hw.sh:11-21).
+"""
+
+import tarfile
+
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.scaffold import (
+    FAILED,
+    PASSED,
+    SKIPPED,
+    cmd_new,
+    cmd_package,
+    cmd_test,
+    run_case,
+)
+
+
+@pytest.fixture()
+def hw(tmp_path):
+    cmd_new(tmp_path, 1)
+    return tmp_path
+
+
+def test_new_generates_files(hw):
+    assert (hw / "hw1" / "src" / "template.py").exists()
+    assert (hw / "hw1" / "summary.md").exists()
+    text = (hw / "hw1" / "src" / "template.py").read_text()
+    assert "hw1" in text and "{HW_NUM}" not in text
+
+
+def test_new_refuses_overwrite(hw, capsys):
+    marker = "# my edit\n"
+    f = hw / "hw1" / "src" / "template.py"
+    f.write_text(f.read_text() + marker)
+    cmd_new(hw, 1)
+    assert marker in f.read_text()
+    assert "skip (exists)" in capsys.readouterr().out
+
+
+def test_generated_template_passes(hw):
+    entry = hw / "hw1" / "src" / "template.py"
+    status, wall, detail = run_case(entry, 128, 2, timeout_s=120.0)
+    assert status == PASSED, detail
+
+
+def test_run_case_skips_nondivisible(hw):
+    entry = hw / "hw1" / "src" / "template.py"
+    assert run_case(entry, 128, 3, timeout_s=120.0)[0] == SKIPPED
+
+
+def test_sweep_exit_codes(hw):
+    # Trim the matrix for test speed; semantics are what's under test.
+    rc = cmd_test(hw, 1, sizes=(128,), np_counts=(1, 3), timeout_s=120.0)
+    assert rc == 0  # np=3 skipped, np=1 passed
+    entry = hw / "hw1" / "src" / "template.py"
+    entry.write_text(entry.read_text().replace("Test: PASSED", "Test: BROKEN"))
+    assert cmd_test(hw, 1, sizes=(128,), np_counts=(1,), timeout_s=120.0) == 1
+
+
+def test_sweep_missing_experiment(tmp_path):
+    assert cmd_test(tmp_path, 9, sizes=(128,), np_counts=(1,)) == 1
+
+
+def test_package_naming_and_contents(hw):
+    archive = cmd_package(hw, 1, "Doe", "Jane")
+    assert archive.name == "hw1-doe-jane.tgz"
+    with tarfile.open(archive) as tf:
+        names = tf.getnames()
+    assert "hw1-doe-jane/src/template.py" in names
+    assert "hw1-doe-jane/summary.md" in names
+
+
+def test_package_missing_source(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        cmd_package(tmp_path, 2, "doe", "jane")
