@@ -10,8 +10,8 @@ exact machine-parseable stdout contract its harness greps
     Final Output (first 10 values): 29.2932 25.9153 ...
     AlexNet TPU Forward Pass completed in X ms
 
-Usage (run from the repo root so cwd is importable; PYTHONPATH must stay
-unset — it disables the TPU plugin):
+Usage (run from the repo root so cwd is importable; leave the ambient
+PYTHONPATH alone — it loads the TPU plugin's sitecustomize):
 
     python -m cuda_mpi_gpu_cluster_programming_tpu.run --config v1_jit --batch 1
 """
@@ -33,6 +33,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch", type=int, default=1, help="batch size (reference is strictly batch-1)")
     p.add_argument("--shards", type=int, default=1, help="row-shard count (mpirun -np analogue)")
     p.add_argument("--init", choices=["deterministic", "random"], default="deterministic")
+    p.add_argument(
+        "--input",
+        choices=["jax", "native"],
+        default="jax",
+        help="input source: jax = on-device init, native = C++ data pipeline",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--repeats", type=int, default=10, help="fenced passes for amortized timing")
     p.add_argument(
@@ -49,6 +55,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--params", help="load weights from this .npz checkpoint instead of --init")
     p.add_argument("--save-params", help="save the weights used to this .npz checkpoint")
     p.add_argument("--list-configs", action="store_true")
+    p.add_argument(
+        "--breakdown",
+        action="store_true",
+        help="also print a fenced per-layer timing breakdown (XLA-op tier)",
+    )
+    p.add_argument(
+        "--profile",
+        metavar="DIR",
+        help="capture a jax.profiler trace of the timed passes into DIR",
+    )
     return p
 
 
@@ -107,25 +123,37 @@ def main(argv=None) -> int:
     else:
         init_det, init_rnd = init_params_deterministic, init_params_random
     input_cfg = blocks_cfg  # inputs depend only on the Blocks 1-2 input dims
+    # kp/kx derivation is shared by every branch, so --params w.npz --seed S
+    # reproduces the exact inputs of the run that saved w.npz.
+    kp, kx = jax.random.split(jax.random.PRNGKey(args.seed))
     if args.params:
         from .utils.checkpoint import load_params_npz
 
         params = load_params_npz(args.params)
         print(f"Loaded params from {args.params}")
-        if args.init == "deterministic":
-            x = deterministic_input(args.batch, input_cfg)
-        else:
-            # Same kx derivation as the init path, so --params w.npz --seed S
-            # reproduces the exact inputs of the run that saved w.npz.
-            _, kx = jax.random.split(jax.random.PRNGKey(args.seed))
-            x = random_input(kx, args.batch, input_cfg)
     elif args.init == "deterministic":
         params = init_det(model_cfg)
+    else:
+        params = init_rnd(kp, model_cfg)
+
+    if args.input == "native":
+        # C++ pipeline generates the batch host-side (the reference's C++
+        # initializeData analogue); deterministic mode is bit-identical to the
+        # jax path, random mode uses the native LCG stream instead of
+        # jax.random (documented, seeded, reproducible).
+        from . import native
+
+        mode = "ones" if args.init == "deterministic" else "uniform"
+        x = jax.device_put(
+            native.fill_batch(
+                (args.batch, input_cfg.in_height, input_cfg.in_width, input_cfg.in_channels),
+                mode=mode,
+                seed=args.seed,
+            )
+        )
+    elif args.init == "deterministic":
         x = deterministic_input(args.batch, input_cfg)
     else:
-        key = jax.random.PRNGKey(args.seed)
-        kp, kx = jax.random.split(key)
-        params = init_rnd(kp, model_cfg)
         x = random_input(kx, args.batch, input_cfg)
     if args.save_params:
         from .utils.checkpoint import save_params_npz
@@ -142,9 +170,18 @@ def main(argv=None) -> int:
     jax.block_until_ready(fwd(params, x))
     compile_ms = (time.perf_counter() - t0) * 1e3
     n_small = max(1, args.warmup)
-    per_pass_ms = amortized_ms(
-        fwd, params, x, n_small=n_small, n_large=n_small + max(1, args.repeats)
-    )
+    if args.profile:
+        from .utils.profiling import trace as profile_ctx
+    else:
+        import contextlib
+
+        profile_ctx = lambda _dir: contextlib.nullcontext()  # noqa: E731
+    with profile_ctx(args.profile):
+        per_pass_ms = amortized_ms(
+            fwd, params, x, n_small=n_small, n_large=n_small + max(1, args.repeats)
+        )
+    if args.profile:
+        print(f"Profiler trace written to {args.profile}")
     out = np.asarray(fwd(params, x))
 
     shape_str = "x".join(str(d) for d in out.shape[1:])
@@ -158,6 +195,16 @@ def main(argv=None) -> int:
         f"(amortized over {args.repeats} fenced passes; "
         f"{args.batch / (per_pass_ms / 1e3):.1f} img/s)"
     )
+    if args.breakdown:
+        from .utils.profiling import layer_breakdown
+
+        # Per-layer costs of the XLA-op tier (the per-phase breakdown the
+        # reference lists as future work, reference README.md:233).
+        for name, ms, shape in layer_breakdown(
+            params, x, blocks_cfg, repeats=max(1, args.repeats), warmup=n_small
+        ):
+            shape_s = "x".join(str(d) for d in shape[1:])
+            print(f"Layer {name} completed in {ms:.3f} ms -> {shape_s}")
     return 0
 
 

@@ -1,0 +1,81 @@
+"""Profiling: per-layer breakdown, named scopes, and trace capture.
+
+The reference's only profiling is one wall-clock print per pass — the
+"completed in X ms" line its harness regexes (SURVEY §5.1: "timing
+print-format IS the profiling API") — while per-phase breakdowns and real
+profilers are documented as future work (reference README.md:233,720-735).
+This module ships them:
+
+- :func:`forward_annotated` — the Blocks 1-2 pass with ``jax.named_scope``
+  around every layer, so XLA profiler traces attribute time per layer.
+- :func:`layer_breakdown` — fenced per-layer wall timing (each prefix of the
+  layer chain jitted separately; per-layer cost by differencing is wrong on
+  an async device, so each stage is timed end-to-end on its own).
+- :func:`trace` — ``jax.profiler.trace`` wrapper writing a TensorBoard-able
+  trace directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Tuple
+
+import jax
+
+from ..models.alexnet import BLOCKS12, Blocks12Config, Params
+from ..ops import reference as ops
+from .timing import amortized_ms
+
+
+def stage_fns(
+    cfg: Blocks12Config = BLOCKS12,
+) -> List[Tuple[str, Callable[[Params, jax.Array], jax.Array]]]:
+    """(name, fn) per layer; each fn maps that layer's input to its output."""
+    c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
+    return [
+        ("conv1", lambda p, x: ops.conv2d(x, p["conv1"]["w"], p["conv1"]["b"], stride=c1.stride, padding=c1.padding)),
+        ("relu1", lambda p, x: ops.relu(x)),
+        ("pool1", lambda p, x: ops.maxpool(x, window=p1.window, stride=p1.stride)),
+        ("conv2", lambda p, x: ops.conv2d(x, p["conv2"]["w"], p["conv2"]["b"], stride=c2.stride, padding=c2.padding)),
+        ("relu2", lambda p, x: ops.relu(x)),
+        ("pool2", lambda p, x: ops.maxpool(x, window=p2.window, stride=p2.stride)),
+        ("lrn2", lambda p, x: ops.lrn(x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size)),
+    ]
+
+
+def forward_annotated(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
+    """forward_blocks12 with a named scope per layer (for profiler traces)."""
+    for name, fn in stage_fns(cfg):
+        with jax.named_scope(name):
+            x = fn(params, x)
+    return x
+
+
+def layer_breakdown(
+    params: Params,
+    x: jax.Array,
+    cfg: Blocks12Config = BLOCKS12,
+    repeats: int = 10,
+    warmup: int = 3,
+) -> List[Tuple[str, float, Tuple[int, ...]]]:
+    """Fenced per-layer timing: [(layer, ms, output_shape), ...].
+
+    Each layer is timed on its *actual* input (the previous layer's output,
+    computed once outside the timed region), jitted standalone, with the
+    same amortized fence protocol as the headline timing.
+    """
+    rows: List[Tuple[str, float, Tuple[int, ...]]] = []
+    cur = x
+    for name, fn in stage_fns(cfg):
+        jfn = jax.jit(fn)
+        ms = amortized_ms(jfn, params, cur, n_small=max(1, warmup), n_large=max(1, warmup) + max(1, repeats))
+        cur = jax.block_until_ready(jfn(params, cur))
+        rows.append((name, ms, tuple(cur.shape)))
+    return rows
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace of the enclosed region into ``log_dir``."""
+    with jax.profiler.trace(log_dir):
+        yield

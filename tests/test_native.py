@@ -1,0 +1,118 @@
+"""Native (C++) tier parity: shard planner and data pipeline vs Python.
+
+The native library mirrors host-side logic the reference keeps in C++ —
+shape helpers (2.2_scatter_halo/include/alexnet.hpp:35-44), ownership/trim
+math (v4_mpi_cuda/src/alexnet_mpi_cuda.cu:27-38), and data-synthesis loops
+(v1_serial/src/alexnet_serial.cpp:39-57). Every surface is cross-validated
+against the Python source of truth.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+from cuda_mpi_gpu_cluster_programming_tpu import native
+from cuda_mpi_gpu_cluster_programming_tpu.ops import shapes
+from cuda_mpi_gpu_cluster_programming_tpu.parallel import plan
+
+
+class TestShapeParity:
+    def test_conv_out_dim_grid(self):
+        for d in (0, 1, 3, 13, 27, 55, 63, 227):
+            for f in (1, 3, 5, 11, 300):
+                for p in (0, 1, 2, 5):
+                    for s in (1, 2, 4):
+                        assert native.conv_out_dim(d, f, p, s) == shapes.conv_out_dim(
+                            d, f, p, s
+                        ), (d, f, p, s)
+
+    def test_pool_out_dim_grid(self):
+        for d in (0, 1, 3, 13, 27, 55, 227):
+            for f in (1, 2, 3, 500):
+                for s in (1, 2, 3):
+                    assert native.pool_out_dim(d, f, s) == shapes.pool_out_dim(d, f, s)
+
+    def test_degenerate_guards(self):
+        assert native.conv_out_dim(5, 11, 0, 4) == 0  # filter can't fit (V4 guard)
+        assert native.pool_out_dim(2, 3, 2) == 0
+        assert native.conv_out_dim(-1, 3, 0, 1) == 0
+
+
+class TestPlanParity:
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 16])
+    def test_blocks12_chain(self, n):
+        assert native.make_shard_plan_native(BLOCKS12, n) == plan.make_shard_plan(
+            BLOCKS12, n
+        )
+
+    @pytest.mark.parametrize("n", [1, 2, 4, 8])
+    @pytest.mark.parametrize("h", [63, 67, 95, 127, 227])
+    def test_odd_heights(self, h, n):
+        cfg = dataclasses.replace(BLOCKS12, in_height=h, in_width=h)
+        assert native.make_shard_plan_native(cfg, n) == plan.make_shard_plan(cfg, n)
+
+    def test_owned_range_parity(self):
+        for l_out in (13, 27, 55, 227):
+            for n in (1, 2, 4, 8):
+                b = -(-l_out // n)
+                for i in range(n):
+                    assert native.owned_range_native(b, l_out, i) == plan.owned_range(
+                        b, l_out, i
+                    )
+
+    def test_degenerate_chain_raises(self):
+        cfg = dataclasses.replace(BLOCKS12, in_height=5, in_width=5)
+        with pytest.raises(ValueError, match="degenerate"):
+            native.make_shard_plan_native(cfg, 2)
+
+
+class TestDataPipeline:
+    def test_ones_mode(self):
+        out = native.fill_batch((2, 4, 4, 3), mode="ones")
+        np.testing.assert_array_equal(out, np.ones((2, 4, 4, 3), np.float32))
+
+    def test_uniform_stream_matches_numpy_oracle(self):
+        for seed in (0, 1, 123456789, 2**63):
+            got = native.fill_batch((257,), mode="uniform", seed=seed)
+            np.testing.assert_array_equal(got, native.lcg_uniform_numpy(seed, 257))
+
+    def test_uniform_range_and_spread(self):
+        x = native.fill_batch((10_000,), mode="uniform", seed=7)
+        assert x.min() >= 0.0 and x.max() < 1.0
+        assert abs(float(x.mean()) - 0.5) < 0.02
+
+    def test_seed_determinism(self):
+        a = native.fill_batch((64,), mode="uniform", seed=42)
+        b = native.fill_batch((64,), mode="uniform", seed=42)
+        c = native.fill_batch((64,), mode="uniform", seed=43)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    @pytest.mark.parametrize("workers,depth", [(1, 1), (2, 2), (4, 3)])
+    def test_loader_ordered_and_timing_independent(self, workers, depth):
+        shape = (2, 5, 5, 3)
+        with native.NativeDataLoader(
+            shape, mode="uniform", seed=99, depth=depth, workers=workers
+        ) as dl:
+            batches = [next(dl) for _ in range(6)]
+        for k, got in enumerate(batches):
+            want = native.fill_batch(shape, mode="uniform", seed=native.batch_seed(99, k))
+            np.testing.assert_array_equal(got, want, err_msg=f"batch {k}")
+
+    def test_loader_close_idempotent(self):
+        dl = native.NativeDataLoader((1, 2, 2, 1), workers=2)
+        next(dl)
+        dl.close()
+        dl.close()
+        with pytest.raises(StopIteration):
+            next(dl)
+
+    def test_loader_feeds_model_input_shape(self):
+        # The oracle input (ones) produced natively equals models.init's.
+        from cuda_mpi_gpu_cluster_programming_tpu.models.init import deterministic_input
+
+        with native.NativeDataLoader((2, 227, 227, 3), mode="ones") as dl:
+            x = next(dl)
+        np.testing.assert_array_equal(x, np.asarray(deterministic_input(batch=2)))

@@ -1,0 +1,53 @@
+"""Profiling subsystem: breakdown correctness, annotated-pass equivalence."""
+
+import glob
+import os
+
+import jax
+import numpy as np
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12, forward_blocks12
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    deterministic_input,
+    init_params_deterministic,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.utils import profiling
+
+
+def test_annotated_forward_matches_plain():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    a = jax.jit(profiling.forward_annotated)(params, x)
+    b = jax.jit(forward_blocks12)(params, x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stage_fns_compose_to_forward():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    cur = x
+    for _, fn in profiling.stage_fns(BLOCKS12):
+        cur = fn(params, cur)
+    np.testing.assert_array_equal(
+        np.asarray(cur), np.asarray(forward_blocks12(params, x))
+    )
+
+
+def test_layer_breakdown_rows():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    rows = profiling.layer_breakdown(params, x, repeats=1, warmup=1)
+    names = [r[0] for r in rows]
+    assert names == ["conv1", "relu1", "pool1", "conv2", "relu2", "pool2", "lrn2"]
+    assert all(ms >= 0.0 for _, ms, _ in rows)
+    assert rows[-1][2] == (1, 13, 13, 256)
+    assert rows[0][2] == (1, 55, 55, 96)
+
+
+def test_trace_writes_files(tmp_path):
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    d = str(tmp_path / "trace")
+    with profiling.trace(d):
+        jax.block_until_ready(jax.jit(profiling.forward_annotated)(params, x))
+    assert glob.glob(os.path.join(d, "**", "*"), recursive=True)
