@@ -126,13 +126,39 @@ def build_forward(
     model_cfg=None,
     n_shards: int = 1,
     mesh: Optional[jax.sharding.Mesh] = None,
+    compute: str = "fp32",
 ) -> Callable:
     """Return a jitted ``(params, x) -> out`` for the given execution config.
 
     ``model_cfg`` defaults per model family (BLOCKS12 / ALEXNET).
     ``n_shards`` is the TPU analogue of ``mpirun -np N``
     (scripts/common_test_utils.sh:274-276).
+    ``compute`` selects numerics: ``fp32`` (exact reference parity — fp32
+    MACs even on the MXU) or ``bf16`` (params+input cast to bfloat16, fp32
+    accumulation on the MXU, fp32 output — the TPU-native perf mode; halves
+    HBM traffic and engages the MXU's fast path. No reference analogue:
+    CUDA stages are fp32-only).
     """
+    if compute not in ("fp32", "bf16"):
+        raise ValueError(f"unknown compute mode {compute!r} (fp32|bf16)")
+    fwd = _build_forward_fp32(exec_cfg, model_cfg, n_shards, mesh)
+    if compute == "fp32":
+        return fwd
+    import jax.numpy as jnp
+
+    def fwd_bf16(p, x):
+        pb = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        return fwd(pb, x.astype(jnp.bfloat16)).astype(jnp.float32)
+
+    return jax.jit(fwd_bf16)
+
+
+def _build_forward_fp32(
+    exec_cfg: ExecConfig,
+    model_cfg=None,
+    n_shards: int = 1,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Callable:
     need = n_shards if exec_cfg.strategy != "single" else 1
     if mesh is None and jax.device_count() < need:
         raise ValueError(

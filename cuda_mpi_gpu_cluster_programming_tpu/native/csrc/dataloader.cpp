@@ -67,9 +67,11 @@ struct Loader {
   std::mutex mu;
   std::condition_variable cv_produced;  // consumer waits for ready[next_out]
   std::condition_variable cv_space;     // workers wait for buffer space
+  std::condition_variable cv_drained;   // destroyer waits for consumers to leave
   std::map<int64_t, std::vector<float>> ready;
   std::atomic<int64_t> next_claim{0};
   int64_t next_out = 0;
+  int consumers_inside = 0;
   bool stopping = false;
 
   void worker() {
@@ -130,15 +132,20 @@ int64_t dl_next(void* handle, float* out) {
   int64_t k;
   {
     std::unique_lock<std::mutex> lk(L->mu);
+    ++L->consumers_inside;  // dl_destroy waits for us to leave before delete
     L->cv_produced.wait(lk, [&] {
       return L->stopping || L->ready.count(L->next_out) > 0;
     });
-    if (L->stopping) return -1;
+    if (L->stopping) {
+      if (--L->consumers_inside == 0) L->cv_drained.notify_all();
+      return -1;
+    }
     k = L->next_out;
     buf = std::move(L->ready[k]);
     L->ready.erase(k);
     L->next_out = k + 1;
     L->cv_space.notify_all();
+    if (--L->consumers_inside == 0) L->cv_drained.notify_all();
   }
   std::memcpy(out, buf.data(), sizeof(float) * static_cast<size_t>(L->batch_elems));
   return k;
@@ -147,11 +154,14 @@ int64_t dl_next(void* handle, float* out) {
 void dl_destroy(void* handle) {
   auto* L = static_cast<Loader*>(handle);
   {
-    std::lock_guard<std::mutex> lk(L->mu);
+    std::unique_lock<std::mutex> lk(L->mu);
     L->stopping = true;
+    L->cv_space.notify_all();
+    L->cv_produced.notify_all();
+    // A consumer may still be blocked inside dl_next; deleting the mutex
+    // under it is UB. Wait for every consumer to observe `stopping` and exit.
+    L->cv_drained.wait(lk, [&] { return L->consumers_inside == 0; });
   }
-  L->cv_space.notify_all();
-  L->cv_produced.notify_all();
   for (auto& t : L->workers) t.join();
   delete L;
 }

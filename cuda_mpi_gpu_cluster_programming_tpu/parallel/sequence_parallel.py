@@ -1,0 +1,167 @@
+"""Long-context sequence parallelism: ring attention + Ulysses all-to-all.
+
+The reference's only long-axis decomposition is its 1-D image-row scatter
+with neighbor halo exchange (SURVEY §5.7 — "mechanically identical to
+context-parallel stencil pipelines"). This module is the genuine long-context
+tier built on the same mesh machinery:
+
+- :func:`ring_attention` — blockwise attention with online-softmax
+  accumulation; K/V blocks circulate the ring via ``lax.ppermute`` over ICI
+  while every shard keeps only ``L/n`` of the sequence resident. Memory per
+  chip is O(L/n), so context length scales linearly with the ring size.
+- :func:`ulysses_attention` — all-to-all sequence parallelism: reshard from
+  sequence-sharded to head-sharded with ``lax.all_to_all``, run exact local
+  attention over the full sequence for the local heads, reshard back.
+  Communication is two all-to-alls instead of n ppermute hops; needs
+  ``n_heads % n_shards == 0``.
+
+Both are validated shard-vs-single against ``ops.attention.attention`` on
+the virtual 8-device mesh (tests/test_sequence_parallel.py), the same
+equivalence discipline as the conv pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import NEG_INF
+from .mesh import make_mesh
+
+
+def _block_scores(q, k, scale):
+    """(B, Lq, H, D) x (B, Lk, H, D) -> fp32 scores (B, H, Lq, Lk)."""
+    return jnp.einsum(
+        "blhd,bmhd->bhlm", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: bool):
+    """Per-shard body: online-softmax over ring-circulating K/V blocks.
+
+    q/k/v: this shard's (B, Lb, H, D) block. At step t the resident K/V
+    block is the one originally owned by shard ``(me - t) mod n`` (each step
+    ppermutes blocks one hop forward around the ring).
+    """
+    b, lb, h, d = q.shape
+    me = lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q_pos = me * lb + jnp.arange(lb)  # global positions of my queries
+
+    def step(t, carry):
+        k_blk, v_blk, m, num, den = carry
+        src = (me - t) % n_shards  # original owner of the resident block
+        s = _block_scores(q, k_blk, scale)  # (B, H, Lb, Lb)
+        if causal:
+            k_pos = src * lb + jnp.arange(lb)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1)  # (B, H, Lb)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])  # (B, H, Lb, Lb)
+        num = num * corr[..., None] + jnp.einsum(
+            "bhlm,bmhd->bhld", p, v_blk.astype(jnp.float32)
+        )
+        den = den * corr + jnp.sum(p, axis=-1)
+        # Circulate K/V one hop: shard i -> shard (i+1) mod n.
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m_new, num, den
+
+    m0 = jnp.full((b, h, lb), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((b, h, lb, d), jnp.float32)
+    den0 = jnp.zeros((b, h, lb), jnp.float32)
+    # n_shards is a Python int: the loop unrolls at trace time, so the
+    # causal source index `src` stays partially static-friendly; ppermute
+    # count is exactly n_shards (the last rotation restores ownership).
+    carry = (k, v, m0, num0, den0)
+    for t in range(n_shards):
+        carry = step(t, carry)
+    _, _, _, num, den = carry
+    out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, Lb, D)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    n_shards: int,
+    causal: bool = False,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Sequence-sharded blockwise ring attention. q,k,v: (B, L, H, D).
+
+    The sequence axis is sharded ``n_shards`` ways; K/V blocks ride the ring
+    via ``ppermute`` (ICI neighbor traffic, the same collective as the conv
+    halo exchange). Requires ``L % n_shards == 0``.
+    """
+    b, l, h, d = q.shape
+    if l % n_shards != 0:
+        raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
+    if mesh is None:
+        mesh = make_mesh(n_shards, axis_name=axis_name)
+    body = functools.partial(
+        _ring_attention_local, axis_name=axis_name, n_shards=n_shards, causal=causal
+    )
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body: all_to_all L-shard -> H-shard, exact attention, back."""
+    from ..ops.attention import attention
+
+    # (B, Lb, H, D) -> (B, L, Hb, D): concat sequence, split heads.
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    out = attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    return to_seq(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    n_shards: int,
+    causal: bool = False,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """All-to-all (Ulysses-style) sequence parallelism. q,k,v: (B, L, H, D).
+
+    Resharding sequence->heads makes each shard run *exact* attention over
+    the full sequence for ``H/n`` heads; two tiled ``all_to_all`` collectives
+    replace the ring's n ppermute hops. Requires ``L % n == 0`` and
+    ``H % n == 0``.
+    """
+    b, l, h, d = q.shape
+    if l % n_shards != 0:
+        raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
+    if h % n_shards != 0:
+        raise ValueError(f"head count {h} not divisible by {n_shards} shards")
+    if mesh is None:
+        mesh = make_mesh(n_shards, axis_name=axis_name)
+    body = functools.partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return fn(q, k, v)

@@ -45,6 +45,12 @@ def make_parser() -> argparse.ArgumentParser:
         "--warmup", type=int, default=5, help="short-queue passes subtracted by the fence protocol"
     )
     p.add_argument(
+        "--compute",
+        choices=["fp32", "bf16"],
+        default="fp32",
+        help="fp32 = exact reference-parity numerics; bf16 = MXU fast path",
+    )
+    p.add_argument(
         "--lrn-form",
         choices=["cuda", "cpu"],
         default="cuda",
@@ -141,16 +147,20 @@ def main(argv=None) -> int:
         # initializeData analogue); deterministic mode is bit-identical to the
         # jax path, random mode uses the native LCG stream instead of
         # jax.random (documented, seeded, reproducible).
-        from . import native
+        try:
+            from . import native
 
-        mode = "ones" if args.init == "deterministic" else "uniform"
-        x = jax.device_put(
-            native.fill_batch(
-                (args.batch, input_cfg.in_height, input_cfg.in_width, input_cfg.in_channels),
-                mode=mode,
-                seed=args.seed,
+            mode = "ones" if args.init == "deterministic" else "uniform"
+            x = jax.device_put(
+                native.fill_batch(
+                    (args.batch, input_cfg.in_height, input_cfg.in_width, input_cfg.in_channels),
+                    mode=mode,
+                    seed=args.seed,
+                )
             )
-        )
+        except RuntimeError as e:  # toolchain missing / native build broke
+            print(f"cannot build native input tier: {e}", file=sys.stderr)
+            return 2
     elif args.init == "deterministic":
         x = deterministic_input(args.batch, input_cfg)
     else:
@@ -162,7 +172,7 @@ def main(argv=None) -> int:
         print(f"Saved params to {args.save_params}")
 
     try:
-        fwd = build_forward(exec_cfg, model_cfg, n_shards=args.shards)
+        fwd = build_forward(exec_cfg, model_cfg, n_shards=args.shards, compute=args.compute)
     except (ValueError, NotImplementedError, ModuleNotFoundError) as e:
         print(f"cannot build config {exec_cfg.key!r}: {e}", file=sys.stderr)
         return 2
@@ -201,7 +211,7 @@ def main(argv=None) -> int:
         # Per-layer costs of the XLA-op tier (the per-phase breakdown the
         # reference lists as future work, reference README.md:233).
         for name, ms, shape in layer_breakdown(
-            params, x, blocks_cfg, repeats=max(1, args.repeats), warmup=n_small
+            params, x, model_cfg, repeats=max(1, args.repeats), warmup=n_small
         ):
             shape_s = "x".join(str(d) for d in shape[1:])
             print(f"Layer {name} completed in {ms:.3f} ms -> {shape_s}")

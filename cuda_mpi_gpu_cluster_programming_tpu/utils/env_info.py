@@ -67,9 +67,12 @@ def collect(probe_devices: bool = True) -> Dict[str, object]:
 def cpu_subprocess_env(n_devices: int) -> Dict[str, str]:
     """Environment for a subprocess that must run on N virtual CPU devices
     (the ``mpirun --oversubscribe`` analogue). Single home for the TPU-plugin
-    gotchas: PYTHONPATH (even empty) breaks the axon plugin, the ambient
-    sitecustomize registers the TPU unless PALLAS_AXON_POOL_IPS is blanked,
-    and any prior device-count flag must be spliced out of XLA_FLAGS."""
+    gotchas: the ambient ``PYTHONPATH=/root/.axon_site`` sitecustomize
+    registers the TPU at interpreter startup, so for a CPU-only child we drop
+    PYTHONPATH *and* blank PALLAS_AXON_POOL_IPS to disable that registration
+    (conversely, a child that *wants* the TPU must inherit PYTHONPATH
+    untouched), and any prior device-count flag must be spliced out of
+    XLA_FLAGS."""
     import re
 
     env = dict(os.environ)

@@ -22,15 +22,54 @@ from typing import Callable, List, Tuple
 
 import jax
 
-from ..models.alexnet import BLOCKS12, Blocks12Config, Params
+from ..models.alexnet import BLOCKS12, Blocks12Config, ConvSpec, LrnSpec, Params, PoolSpec
 from ..ops import reference as ops
 from .timing import amortized_ms
 
 
+def _conv_stage(name: str, spec: ConvSpec, fuse_relu: bool):
+    def fn(p, x):
+        out = ops.conv2d(
+            x, p[name]["w"], p[name]["b"], stride=spec.stride, padding=spec.padding
+        )
+        return ops.relu(out) if fuse_relu else out
+
+    return fn
+
+
+def _fc_stage(name: str, relu_after: bool):
+    def fn(p, x):
+        x = x.reshape(x.shape[0], -1)
+        out = x @ p[name]["w"] + p[name]["b"]
+        return ops.relu(out) if relu_after else out
+
+    return fn
+
+
 def stage_fns(
-    cfg: Blocks12Config = BLOCKS12,
+    cfg=BLOCKS12,
 ) -> List[Tuple[str, Callable[[Params, jax.Array], jax.Array]]]:
-    """(name, fn) per layer; each fn maps that layer's input to its output."""
+    """(name, fn) per layer; each fn maps that layer's input to its output.
+
+    Accepts a ``Blocks12Config`` (relu is its own stage, matching the
+    reference's 7-layer print chain) or an ``AlexNetConfig`` (relu fused
+    into each conv stage as in ``alexnet_full.forward_spatial``, plus the
+    FC6-8 head stages).
+    """
+    full = hasattr(cfg, "blocks12")  # AlexNetConfig
+    stages: List[Tuple[str, Callable]] = []
+    if full:
+        for name, spec in cfg.layer_chain():
+            if isinstance(spec, ConvSpec):
+                stages.append((name, _conv_stage(name, spec, fuse_relu=True)))
+            elif isinstance(spec, PoolSpec):
+                stages.append((name, lambda p, x, s=spec: ops.maxpool(x, window=s.window, stride=s.stride)))
+            elif isinstance(spec, LrnSpec):
+                stages.append((name, lambda p, x, s=spec: ops.lrn(x, size=s.size, alpha=s.alpha, beta=s.beta, k=s.k, alpha_over_size=s.alpha_over_size)))
+        stages.append(("fc6", _fc_stage("fc6", relu_after=True)))
+        stages.append(("fc7", _fc_stage("fc7", relu_after=True)))
+        stages.append(("fc8", _fc_stage("fc8", relu_after=False)))
+        return stages
     c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
     return [
         ("conv1", lambda p, x: ops.conv2d(x, p["conv1"]["w"], p["conv1"]["b"], stride=c1.stride, padding=c1.padding)),
@@ -43,8 +82,8 @@ def stage_fns(
     ]
 
 
-def forward_annotated(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
-    """forward_blocks12 with a named scope per layer (for profiler traces)."""
+def forward_annotated(params: Params, x: jax.Array, cfg=BLOCKS12) -> jax.Array:
+    """The model's forward pass with a named scope per layer (for traces)."""
     for name, fn in stage_fns(cfg):
         with jax.named_scope(name):
             x = fn(params, x)
@@ -54,7 +93,7 @@ def forward_annotated(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS
 def layer_breakdown(
     params: Params,
     x: jax.Array,
-    cfg: Blocks12Config = BLOCKS12,
+    cfg=BLOCKS12,
     repeats: int = 10,
     warmup: int = 3,
 ) -> List[Tuple[str, float, Tuple[int, ...]]]:

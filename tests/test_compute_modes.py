@@ -1,0 +1,67 @@
+"""bf16 compute mode: every tier/strategy, close to fp32, fp32 output dtype.
+
+The bf16 mode has no reference analogue (all CUDA stages are fp32) — it is
+the TPU-native perf path: bf16 operands, fp32 MXU accumulation, fp32 output.
+"""
+
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    deterministic_input,
+    init_params_deterministic,
+)
+
+GOLDEN_FIRST4 = np.array([29.2932, 25.9153, 23.3255, 23.3255], np.float32)
+
+
+@pytest.mark.parametrize(
+    "key,shards",
+    [
+        ("v1_jit", 1),
+        ("v3_pallas", 1),
+        ("v2.2_sharded", 4),
+        ("v5_collective", 8),
+        ("v4_hybrid", 2),
+    ],
+)
+def test_bf16_close_to_fp32(key, shards):
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    cfg = REGISTRY[key]
+    exact = np.asarray(build_forward(cfg, n_shards=shards)(params, x))
+    fast = np.asarray(build_forward(cfg, n_shards=shards, compute="bf16")(params, x))
+    assert fast.dtype == np.float32
+    assert fast.shape == exact.shape
+    # bf16 has ~8 mantissa bits; the deterministic workload is smooth, so
+    # 2% relative agreement is ample to catch wiring bugs without flaking.
+    np.testing.assert_allclose(fast, exact, rtol=2e-2, atol=1e-2)
+
+
+def test_bf16_golden_neighborhood():
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    out = np.asarray(build_forward(REGISTRY["v1_jit"], compute="bf16")(params, x))
+    np.testing.assert_allclose(out[0].reshape(-1)[:4], GOLDEN_FIRST4, rtol=2e-2)
+
+
+def test_unknown_compute_rejected():
+    with pytest.raises(ValueError, match="compute mode"):
+        build_forward(REGISTRY["v1_jit"], compute="fp16")
+
+
+def test_bf16_full_model():
+    from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet_full import (
+        init_full_deterministic,
+    )
+
+    params = init_full_deterministic()
+    x = deterministic_input(batch=2)
+    cfg = REGISTRY["v6_full_jit"]
+    exact = np.asarray(build_forward(cfg)(params, x))
+    fast = np.asarray(build_forward(cfg, compute="bf16")(params, x))
+    assert fast.shape == exact.shape
+    # Deterministic-init logits are uniform across classes; only closeness
+    # of the (large-magnitude) values is meaningful here.
+    np.testing.assert_allclose(fast, exact, rtol=5e-2, atol=5e-2)

@@ -1,0 +1,36 @@
+"""Long-context example CLI: stdout contract + self-verification exit codes."""
+
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.examples import long_context
+
+
+@pytest.mark.parametrize("strategy,shards", [("single", 1), ("ring", 8), ("ulysses", 4)])
+def test_cli_verify_passes(capsys, strategy, shards):
+    rc = long_context.main(
+        [
+            "--strategy", strategy,
+            "--shards", str(shards),
+            "--seq-len", "256",
+            "--heads", "8",
+            "--head-dim", "16",
+            "--repeats", "1",
+            "--warmup", "1",
+            "--verify",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Final Output Shape: 1x256x8x16" in out
+    assert "Attention completed in" in out
+    assert "-> PASSED" in out
+
+
+def test_kv_residency_line(capsys):
+    long_context.main(
+        ["--strategy", "ring", "--shards", "8", "--seq-len", "512",
+         "--repeats", "1", "--warmup", "1"]
+    )
+    out = capsys.readouterr().out
+    # Ring keeps L/n tokens (all heads) resident per device.
+    assert "KV resident per device: 64 tokens x 8 heads" in out
