@@ -59,13 +59,9 @@ def make_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
     if args.fake_devices:
-        import os
+        from ..utils.env_info import force_virtual_cpu
 
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.fake_devices}"
-        ).strip()
-        jax.config.update("jax_platforms", "cpu")
+        force_virtual_cpu(args.fake_devices)
 
     from ..ops.attention import attention
     from ..parallel.sequence_parallel import ring_attention, ulysses_attention

@@ -293,8 +293,9 @@ def run_case(
         # The --oversubscribe analogue: N virtual host devices on CPU.
         env = cpu_subprocess_env(fake_devices)
     else:
+        # Inherit the environment untouched: the ambient PYTHONPATH points at
+        # the sitecustomize that registers the TPU plugin (see verify skill).
         env = dict(os.environ)
-        env.pop("PYTHONPATH", None)  # breaks the TPU plugin (see verify skill)
 
     t0 = time.perf_counter()
     try:
@@ -364,6 +365,11 @@ def make_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--shards", default="1,2,4", help="comma-separated shard counts (np sweep)")
     p.add_argument("--batches", default="1", help="comma-separated batch sizes")
+    p.add_argument(
+        "--computes",
+        default="fp32",
+        help="comma-separated compute modes to sweep (fp32,bf16)",
+    )
     p.add_argument("--timeout", type=float, default=300.0, help="per-case timeout seconds")
     p.add_argument(
         "--fake-devices",
@@ -386,6 +392,11 @@ def main(argv=None) -> int:
     configs = [c.strip() for c in args.configs.split(",") if c.strip()]
     shard_counts = [int(s) for s in args.shards.split(",")]
     batches = [int(b) for b in args.batches.split(",")]
+    computes = [c.strip() for c in args.computes.split(",") if c.strip()]
+    bad = [c for c in computes if c not in ("fp32", "bf16")]
+    if bad:
+        print(f"unknown compute modes: {bad}", file=sys.stderr)
+        return 2
     unknown = [c for c in configs if c not in REGISTRY]
     if unknown:
         print(f"unknown configs: {unknown}", file=sys.stderr)
@@ -402,23 +413,28 @@ def main(argv=None) -> int:
         single = REGISTRY[key].strategy == "single"
         for np_ in [1] if single else shard_counts:
             for batch in batches:
-                # --oversubscribe semantics: with --fake-devices, grow the
-                # virtual mesh to fit np_ so every sweep point actually runs.
-                fake = max(args.fake_devices, np_) if args.fake_devices else 0
-                print(f"[{key} np={np_} b={batch}] ...", end="", flush=True)
-                r = run_case(
-                    session,
-                    key,
-                    variant,
-                    np_,
-                    batch,
-                    timeout_s=args.timeout,
-                    fake_devices=fake,
-                    extra_args=extra,
-                )
-                results.append(r)
-                tail = f"{r.time_ms:.1f} ms" if r.time_ms is not None else r.run_msg
-                print(f" {STATUS_SYMBOL.get(r.status, '?')} {r.status} {tail}")
+                for compute in computes:
+                    # --oversubscribe semantics: with --fake-devices, grow the
+                    # virtual mesh to fit np_ so every sweep point actually runs.
+                    fake = max(args.fake_devices, np_) if args.fake_devices else 0
+                    # bf16 rows get a distinct variant name so the analysis
+                    # warehouse keeps the modes separate (analysis.md:69-92
+                    # canonical-name discipline).
+                    vname = variant if compute == "fp32" else f"{variant} bf16"
+                    print(f"[{key} np={np_} b={batch} {compute}] ...", end="", flush=True)
+                    r = run_case(
+                        session,
+                        key,
+                        vname,
+                        np_,
+                        batch,
+                        timeout_s=args.timeout,
+                        fake_devices=fake,
+                        extra_args=extra + ["--compute", compute],
+                    )
+                    results.append(r)
+                    tail = f"{r.time_ms:.1f} ms" if r.time_ms is not None else r.run_msg
+                    print(f" {STATUS_SYMBOL.get(r.status, '?')} {r.status} {tail}")
 
     print()
     print(summary_table(results))

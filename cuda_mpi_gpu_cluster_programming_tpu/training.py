@@ -25,6 +25,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation | None = None,
     lr: float = 1e-3,
     sp_shards: int = 0,
+    remat: bool = False,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for any optax optimizer (default SGD).
 
@@ -70,6 +71,8 @@ def make_train_step(
                     "the halo/ownership plan would be built for the wrong shard count"
                 )
         sharded_fwd = build_sharded_forward(cfg, n_shards=sp_shards, mesh=mesh)
+        if remat:
+            sharded_fwd = jax.checkpoint(sharded_fwd)
 
         def sp_loss_fn(params, x, y):
             return jnp.mean((sharded_fwd(params, x) - y) ** 2)
@@ -89,8 +92,15 @@ def make_train_step(
         # parallel.sharded, where the collectives are ours.
         return P("dp" if "dp" in names else None)
 
+    def base_fwd(params, x):
+        return forward_blocks12(params, x, cfg)
+
+    if remat:
+        # Trade FLOPs for memory: recompute activations in the backward pass.
+        base_fwd = jax.checkpoint(base_fwd)
+
     def loss_fn(params, x, y):
-        return jnp.mean((forward_blocks12(params, x, cfg) - y) ** 2)
+        return jnp.mean((base_fwd(params, x) - y) ** 2)
 
     def pre(params, x):
         if mesh is None:

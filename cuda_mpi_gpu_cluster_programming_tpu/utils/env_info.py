@@ -86,6 +86,27 @@ def cpu_subprocess_env(n_devices: int) -> Dict[str, str]:
     return env
 
 
+def force_virtual_cpu(n_devices: int) -> None:
+    """In-process twin of :func:`cpu_subprocess_env` for CLIs with a
+    ``--fake-devices`` flag. Must run before any JAX backend initializes.
+
+    Splices any prior device-count flag out of XLA_FLAGS (duplicates only
+    work by last-one-wins luck) and uses ``jax.config.update`` rather than
+    the JAX_PLATFORMS env var, which the ambient sitecustomize has already
+    consumed by the time a CLI main() runs."""
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", os.environ.get("XLA_FLAGS", "")
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main(argv=None) -> int:
     import argparse
 
