@@ -33,7 +33,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--shards", type=int, default=1)
     p.add_argument(
-        "--strategy", choices=["single", "ring", "ulysses"], default="ring"
+        "--strategy",
+        choices=["single", "flash", "ring", "ulysses"],
+        default="ring",
+        help="single = O(L^2) reference op; flash = fused Pallas kernel; "
+        "ring/ulysses = sequence-parallel over the mesh",
     )
     p.add_argument("--causal", action="store_true", default=True)
     p.add_argument("--no-causal", dest="causal", action="store_false")
@@ -76,6 +80,10 @@ def main(argv=None) -> int:
 
     if args.strategy == "single":
         fn = jax.jit(lambda q, k, v: attention(q, k, v, causal=args.causal))
+    elif args.strategy == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=args.causal))
     elif args.strategy == "ring":
         fn = jax.jit(
             lambda q, k, v: ring_attention(

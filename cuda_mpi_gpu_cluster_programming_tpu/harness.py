@@ -266,6 +266,7 @@ def run_case(
     timeout_s: float = 300.0,
     fake_devices: int = 0,
     extra_args: Sequence[str] = (),
+    log_tag: str = "",
 ) -> CaseResult:
     """Build→run→parse pipeline for one case (common_test_utils.sh:223-346).
 
@@ -274,7 +275,8 @@ def run_case(
     """
     r = CaseResult(variant=variant, config_key=config_key, np=np_, batch=batch)
     safe_key = config_key.replace(".", "_")
-    log_path = session.dir / f"run_{safe_key}_np{np_}_b{batch}.log"
+    tag = f"_{log_tag}" if log_tag else ""
+    log_path = session.dir / f"run_{safe_key}_np{np_}_b{batch}{tag}.log"
     r.log_file = log_path.name
 
     cmd = [
@@ -431,6 +433,9 @@ def main(argv=None) -> int:
                         timeout_s=args.timeout,
                         fake_devices=fake,
                         extra_args=extra + ["--compute", compute],
+                        # Distinct log file per compute mode — both sweeps of
+                        # one (config, np, batch) point must keep their logs.
+                        log_tag=compute if len(computes) > 1 else "",
                     )
                     results.append(r)
                     tail = f"{r.time_ms:.1f} ms" if r.time_ms is not None else r.run_msg

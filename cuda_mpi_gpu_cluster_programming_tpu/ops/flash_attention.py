@@ -1,0 +1,130 @@
+"""Pallas flash attention: fused online-softmax attention for TPU.
+
+The hot-op counterpart of ``ops.attention.attention`` (which materializes
+the full (L, L) score matrix in HBM): one kernel per (batch, head, q-block)
+streams K/V through VMEM in blocks, carrying the numerically-stable running
+(max, numerator, denominator) — O(L) memory instead of O(L^2), with the
+QK^T and PV matmuls on the MXU and fp32 accumulation throughout.
+
+Composes with the sequence-parallel tier: ``ring_attention`` shards the
+sequence *across* chips; this kernel is the *within-chip* block engine
+(same online-softmax recurrence, one level down the memory hierarchy).
+
+Runs in Pallas interpreter mode on non-TPU backends so the CPU test mesh
+exercises the identical code path (tests/test_flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .attention import NEG_INF
+
+try:  # pltpu importable everywhere; only used for memory-space hints
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _spec(block_shape, index_map):
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int, causal: bool, scale: float):
+    """One (batch, head, q-block) program.
+
+    q_ref: (1, 1, bq, D); k_ref/v_ref: (1, 1, L, D); o_ref: (1, 1, bq, D).
+    """
+    qi = pl.program_id(2)
+    d = q_ref.shape[-1]
+    l = k_ref.shape[-2]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, D)
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((bq, d), jnp.float32)
+    den0 = jnp.zeros((bq,), jnp.float32)
+
+    def body(j, carry):
+        m, num, den = carry
+        k_blk = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (bk, D)
+        v_blk = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        if causal:
+            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1)  # (bq,)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])  # (bq, bk)
+        num = num * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        den = den * corr + jnp.sum(p, axis=-1)
+        return m_new, num, den
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: iterate only
+        # through the q-block's diagonal block (dynamic trip count).
+        n_blocks = (qi * bq) // bk + pl.cdiv(bq, bk)
+    else:
+        n_blocks = l // bk
+    _, num, den = lax.fori_loop(0, n_blocks, body, (m0, num0, den0))
+    o_ref[0, 0] = (num / jnp.maximum(den, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Fused attention. q,k,v: (B, L, H, D) -> (B, L, H, D).
+
+    ``L`` must be divisible by the (clamped) block sizes. K/V for one head
+    reside in VMEM, bounding L at roughly 16 MB / (8 B * D) per head —
+    beyond that, shard the sequence with ``parallel.sequence_parallel``.
+    """
+    b, l, h, d = q.shape
+    bq = min(block_q, l)
+    bk = min(block_k, l)
+    if l % bq or l % bk:
+        raise ValueError(f"sequence length {l} not divisible by blocks ({bq}, {bk})")
+    scale = 1.0 / (d**0.5)  # Python math: stays static under jit tracing
+
+    # (B, L, H, D) -> (B, H, L, D): heads become a grid axis, L contiguous.
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, l // bq),
+        in_specs=[
+            _spec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            _spec((1, 1, l, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            _spec((1, 1, l, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=_spec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,89 @@
+"""TPU perf sweep: find the best (config, compute, batch) for the headline bench.
+
+Run from the repo root on the real chip (ambient env untouched):
+
+    python scripts/perf_sweep.py               # full sweep -> perf/sweep_<ts>.json
+    python scripts/perf_sweep.py --quick       # 2 points per dimension
+
+Prints one JSON line per point (machine-parseable, harness-style) and a
+final ranking. The winner is the candidate for bench.py's measured config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--repeats", type=int, default=100)
+    ap.add_argument("--out-dir", default="perf")
+    args = ap.parse_args()
+
+    import jax
+
+    from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_ms
+
+    configs = ["v1_jit", "v3_pallas"]
+    computes = ["fp32", "bf16"]
+    batches = [64, 128, 256, 512]
+    if args.quick:
+        configs, computes, batches = ["v1_jit"], ["fp32", "bf16"], [128, 256]
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    params = init_params_deterministic()
+    rows = []
+    for key, compute, batch in itertools.product(configs, computes, batches):
+        x = deterministic_input(batch=batch)
+        try:
+            fwd = build_forward(REGISTRY[key], compute=compute)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fwd(params, x))
+            compile_s = time.perf_counter() - t0
+            ms = amortized_ms(fwd, params, x, n_small=10, n_large=10 + args.repeats)
+            row = {
+                "config": key,
+                "compute": compute,
+                "batch": batch,
+                "ms_per_pass": round(ms, 4),
+                "img_per_sec": round(batch / (ms / 1e3), 1),
+                "compile_s": round(compile_s, 1),
+            }
+        except Exception as e:  # record and continue the sweep
+            row = {"config": key, "compute": compute, "batch": batch,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    ok = [r for r in rows if "img_per_sec" in r]
+    ok.sort(key=lambda r: -r["img_per_sec"])
+    out = {
+        "ts": time.strftime("%Y%m%d_%H%M%S"),
+        "backend": jax.default_backend(),
+        "device": jax.devices()[0].device_kind,
+        "rows": rows,
+        "best": ok[0] if ok else None,
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    path = Path(args.out_dir) / f"sweep_{out['ts']}.json"
+    path.write_text(json.dumps(out, indent=1))
+    print(f"\nbest: {json.dumps(out['best'])}\nsaved: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

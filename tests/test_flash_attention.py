@@ -1,0 +1,58 @@
+"""Pallas flash attention vs the O(L^2) reference op (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.ops.attention import attention
+from cuda_mpi_gpu_cluster_programming_tpu.ops.flash_attention import flash_attention
+
+
+def qkv(key, b=2, l=128, h=4, d=32, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, l, h, d)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("l,block_q,block_k", [(128, 128, 128), (256, 64, 64), (256, 64, 128)])
+def test_matches_reference(causal, l, block_q, block_k):
+    q, k, v = qkv(jax.random.PRNGKey(0), l=l)
+    want = attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_small_sequence_clamps_blocks():
+    q, k, v = qkv(jax.random.PRNGKey(1), l=32)
+    want = attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)  # blocks clamp 128 -> 32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_bf16():
+    q, k, v = qkv(jax.random.PRNGKey(2), dtype=jnp.bfloat16)
+    want = attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_indivisible_rejected():
+    q, k, v = qkv(jax.random.PRNGKey(0), l=96)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+def test_jit():
+    q, k, v = qkv(jax.random.PRNGKey(3), l=64)
+    got = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    want = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)

@@ -5,7 +5,9 @@ import pytest
 from cuda_mpi_gpu_cluster_programming_tpu.examples import long_context
 
 
-@pytest.mark.parametrize("strategy,shards", [("single", 1), ("ring", 8), ("ulysses", 4)])
+@pytest.mark.parametrize(
+    "strategy,shards", [("single", 1), ("flash", 1), ("ring", 8), ("ulysses", 4)]
+)
 def test_cli_verify_passes(capsys, strategy, shards):
     rc = long_context.main(
         [
