@@ -1,0 +1,161 @@
+"""Decoder-only transformer LM: the long-context model family.
+
+The reference has no attention model (SURVEY §2.2); this family is the
+framework's demonstration that its long-context machinery composes into a
+trainable model: pre-norm decoder blocks whose attention op is pluggable —
+
+- ``impl="reference"``: the O(L^2) oracle (``ops.attention``),
+- ``impl="flash"``: the fused Pallas kernel (``ops.flash_attention``),
+- ``impl="ring"``/``"ulysses"``: sequence-parallel over a mesh axis
+  (``parallel.sequence_parallel``) — context length scales with ring size.
+
+Design is TPU-first: pure-functional params pytree, static shapes, RMSNorm,
+learned positional embeddings (static slice — no data-dependent control
+flow), bf16-safe (norms and softmax statistics in fp32), weight-tied LM
+head so the embedding matmul rides the MXU twice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256  # byte-level LM by default
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 1024
+    attn_impl: str = "reference"  # reference | flash | ring | ulysses
+    sp_shards: int = 1  # ring/ulysses mesh size
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+TINY_LM = TransformerConfig()
+
+
+def init_transformer(key: jax.Array, cfg: TransformerConfig = TINY_LM, dtype=jnp.float32) -> Params:
+    """Scaled-normal init (1/sqrt(fan_in); output projections /sqrt(2*L))."""
+    n_mats = 4 * cfg.n_layers + 1
+    keys = iter(jax.random.split(key, n_mats + 1))
+
+    def dense(k, fan_in, shape, scale=1.0):
+        return (jax.random.normal(k, shape, dtype) * scale / math.sqrt(fan_in))
+
+    params: Params = {
+        "embed": dense(next(keys), 1, (cfg.vocab, cfg.d_model)),
+        "pos": dense(next(keys), 1, (cfg.max_len, cfg.d_model)) * 0.02,
+        "final_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
+        "layers": [],
+    }
+    resid_scale = 1.0 / math.sqrt(2 * cfg.n_layers)
+    for _ in range(cfg.n_layers):
+        params["layers"].append(
+            {
+                "attn_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
+                "wqkv": dense(next(keys), cfg.d_model, (cfg.d_model, 3 * cfg.d_model)),
+                "wo": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_model), resid_scale),
+                "mlp_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
+                "w_up": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(next(keys), cfg.d_ff, (cfg.d_ff, cfg.d_model), resid_scale),
+            }
+        )
+    return params
+
+
+def rmsnorm(x: jax.Array, g: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMS layer norm, statistics in fp32 (bf16-safe)."""
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * g
+
+
+def _attend(q, k, v, cfg: TransformerConfig, mesh=None):
+    if cfg.attn_impl == "reference":
+        return attention(q, k, v, causal=True)
+    if cfg.attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        from ..parallel.sequence_parallel import ring_attention
+
+        return ring_attention(q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh)
+    if cfg.attn_impl == "ulysses":
+        from ..parallel.sequence_parallel import ulysses_attention
+
+        return ulysses_attention(q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh)
+    raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+
+
+def forward_lm(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig = TINY_LM,
+    mesh=None,
+) -> jax.Array:
+    """tokens (B, L) int32 -> logits (B, L, vocab). Causal, weight-tied head."""
+    b, l = tokens.shape
+    if l > cfg.max_len:
+        raise ValueError(f"sequence length {l} exceeds max_len {cfg.max_len}")
+    x = params["embed"][tokens] + params["pos"][:l][None]
+    for layer in params["layers"]:
+        h = rmsnorm(x, layer["attn_norm"]["g"])
+        qkv = h @ layer["wqkv"]  # (B, L, 3*D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, l, cfg.n_heads, cfg.head_dim)
+        out = _attend(q.reshape(shape), k.reshape(shape), v.reshape(shape), cfg, mesh)
+        x = x + out.reshape(b, l, cfg.d_model) @ layer["wo"]
+        h = rmsnorm(x, layer["mlp_norm"]["g"])
+        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+    x = rmsnorm(x, params["final_norm"]["g"])
+    return x @ params["embed"].T  # weight-tied LM head
+
+
+def lm_loss(params: Params, tokens: jax.Array, cfg: TransformerConfig = TINY_LM, mesh=None) -> jax.Array:
+    """Next-token cross-entropy (fp32), mean over (B, L-1)."""
+    logits = forward_lm(params, tokens[:, :-1], cfg, mesh).astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_lm_train_step(
+    cfg: TransformerConfig = TINY_LM,
+    mesh=None,
+    optimizer=None,
+    lr: float = 1e-3,
+):
+    """(init_fn, step_fn) for LM training; any optax optimizer (default adam).
+
+    With a mesh whose axes include "dp", the batch is expected sharded over
+    it (GSPMD inserts the gradient all-reduce); ring/ulysses attention adds
+    the "sp" sequence axis inside the forward itself.
+    """
+    import optax
+
+    opt = optimizer if optimizer is not None else optax.adam(lr)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg, mesh)
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state, loss
+
+    return opt.init, step

@@ -79,8 +79,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int, causal: bool,
 
     if causal:
         # Blocks strictly above the diagonal contribute nothing: iterate only
-        # through the q-block's diagonal block (dynamic trip count).
-        n_blocks = (qi * bq) // bk + pl.cdiv(bq, bk)
+        # far enough to cover this q-block's last row (dynamic trip count).
+        n_blocks = pl.cdiv((qi + 1) * bq, bk)
     else:
         n_blocks = l // bk
     _, num, den = lax.fori_loop(0, n_blocks, body, (m0, num0, den0))
@@ -101,7 +101,45 @@ def flash_attention(
     ``L`` must be divisible by the (clamped) block sizes. K/V for one head
     reside in VMEM, bounding L at roughly 16 MB / (8 B * D) per head —
     beyond that, shard the sequence with ``parallel.sequence_parallel``.
+
+    Differentiable: the backward pass recomputes gradients with the O(L^2)
+    reference math (``ops.attention``) under a custom VJP — the fused kernel
+    accelerates the forward/inference path; training at lengths where the
+    quadratic backward is prohibitive should shard the sequence instead.
     """
+    return _flash_diff(causal, block_q, block_k, q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash_diff(causal, block_q, block_k, q, k, v):
+    return _flash_forward(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+
+
+def _flash_diff_fwd(causal, block_q, block_k, q, k, v):
+    out = _flash_forward(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    return out, (q, k, v)
+
+
+def _flash_diff_bwd(causal, block_q, block_k, res, g):
+    from .attention import attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+) -> jax.Array:
     b, l, h, d = q.shape
     bq = min(block_q, l)
     bk = min(block_k, l)

@@ -20,7 +20,18 @@ def qkv(key, b=2, l=128, h=4, d=32, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("l,block_q,block_k", [(128, 128, 128), (256, 64, 64), (256, 64, 128)])
+@pytest.mark.parametrize(
+    "l,block_q,block_k",
+    [
+        (128, 128, 128),
+        (256, 64, 64),
+        (256, 64, 128),
+        # Non-dividing block ratio: fractional block offsets carry, which the
+        # causal trip count must cover ((qi+1)*bq spans a partial k-block).
+        (24, 8, 12),
+        (192, 48, 64),
+    ],
+)
 def test_matches_reference(causal, l, block_q, block_k):
     q, k, v = qkv(jax.random.PRNGKey(0), l=l)
     want = attention(q, k, v, causal=causal)
@@ -56,3 +67,18 @@ def test_jit():
     got = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
     want = attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_grad_matches_reference():
+    q, k, v = qkv(jax.random.PRNGKey(4), b=1, l=64, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,26 @@
+"""Byte-LM example CLI: convergence self-verification across attention impls."""
+
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.examples import lm
+
+
+@pytest.mark.parametrize("attn,shards", [("reference", 1), ("ring", 8)])
+def test_lm_converges(capsys, attn, shards):
+    rc = lm.main(
+        [
+            "--steps", "40",
+            "--attn", attn,
+            "--shards", str(shards),
+            "--seq-len", "64",
+            "--batch", "2",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-> PASSED" in out
+    assert "tok/s" in out
+
+
+def test_steps_guard(capsys):
+    assert lm.main(["--steps", "0"]) == 2
