@@ -48,6 +48,7 @@ CANONICAL_VARIANTS = {
     "v2.2 scatterhalo": "V2.2 ScatterHalo",
     "v3": "V3 CUDA",
     "v3 cuda": "V3 CUDA",
+    "v3 cuda only": "V3 CUDA",
     "v4": "V4 MPI+CUDA",
     "v4 mpi+cuda": "V4 MPI+CUDA",
     "v5": "V5 MPI+CUDA-Aware",
@@ -135,10 +136,57 @@ def _mark(conn: sqlite3.Connection, path: Path, sha1: str, kind: str) -> None:
     )
 
 
+# Reference-schema column mapping (log_analysis.py:45-72 normalises two
+# schema generations; we accept both of them plus our own):
+# gen-2 = the reference's session CSVs (summary_report_*.csv), gen-1 = its
+# early ts/version/np/total_time_s exports (all_runs.csv style).
+_REF_GEN2_MAP = {
+    "ProjectVariant": "Variant",
+    "NumProcesses": "NP",
+    "EntryTimestamp": "Timestamp",
+    "OutputFirst5Values": "First5Values",
+    "RunLogFile": "LogFile",
+    "OverallStatusSymbol": "Status",
+}
+
+
+def _normalize_row(r: dict) -> dict:
+    if "ProjectVariant" in r:  # reference gen-2 session schema
+        out = dict(r)
+        for src, dst in _REF_GEN2_MAP.items():
+            if src in out:
+                out[dst] = out.pop(src)
+        for src, dst in (
+            ("BuildSucceeded", "BuildStatus"),
+            ("RunCommandSucceeded", "RunStatus"),
+            ("ParseSucceeded", "ParseStatus"),
+        ):
+            if src in out:
+                out[dst] = "OK" if str(out.pop(src)).lower() == "true" else "FAIL"
+        # Status symbols (✔/⚠/✘, common_test_utils.sh:119-178) -> our words,
+        # so the perf_runs view's status='OK' filter sees both corpora.
+        out["Status"] = {"✔": "OK", "⚠": "WARN", "✘": "FAIL", "✗": "FAIL"}.get(
+            str(out.get("Status", "")).strip(), out.get("Status")
+        )
+        return out
+    if "version" in r and "total_time_s" in r:  # reference gen-1 export schema
+        out = {
+            "Timestamp": r.get("ts"),
+            "Variant": r.get("version"),
+            "NP": r.get("np"),
+        }
+        if r.get("total_time_s"):
+            out["ExecutionTime_ms"] = str(float(r["total_time_s"]) * 1e3)
+        return out
+    return r
+
+
 def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
-    """Load one harness summary.csv (20-column schema, harness.CSV_COLUMNS)."""
+    """Load one summary CSV — ours (harness.CSV_COLUMNS) or either of the
+    reference's two schema generations, so historical reference data and new
+    TPU data land in one warehouse and plot on the same axes (SURVEY §7.3)."""
     with open(path, newline="") as f:
-        rows = list(csv.DictReader(f))
+        rows = [_normalize_row(r) for r in csv.DictReader(f)]
     conn.execute("DELETE FROM summary_runs WHERE src_csv=?", (str(path),))
     n = 0
     for r in rows:
@@ -243,7 +291,7 @@ WITH base AS (
 SELECT b.variant, b.np, b.batch, b.best_ms,
        base.t1_ms / b.best_ms AS speedup,
        base.t1_ms / b.best_ms / b.np AS efficiency
-FROM best_runs b JOIN base ON base.batch = b.batch
+FROM best_runs b JOIN base ON base.batch IS b.batch
 ORDER BY b.variant, b.batch, b.np
 """
 
@@ -255,7 +303,9 @@ def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
         return []
     print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'best_ms':>10s} {'S(N)':>7s} {'E(N)':>6s}")
     for v, np_, b, ms, s, e in rows:
-        print(f"{v:22s} {np_:3d} {b:5d} {ms:10.3f} {s:7.2f} {e:6.2f}")
+        # batch is NULL for reference-corpus rows (the reference is batch-1
+        # with no batch column).
+        print(f"{v:22s} {np_:3d} {str(b) if b is not None else '-':>5s} {ms:10.3f} {s:7.2f} {e:6.2f}")
     return rows
 
 
