@@ -174,6 +174,10 @@ def _normalize_row(r: dict) -> dict:
             "Timestamp": r.get("ts"),
             "Variant": r.get("version"),
             "NP": r.get("np"),
+            # gen-1 exports (all_runs.csv) contain only completed perf runs —
+            # no status column exists, so mark OK or the perf_runs view's
+            # status='OK' filter would silently drop the whole corpus.
+            "Status": "OK",
         }
         if r.get("total_time_s"):
             out["ExecutionTime_ms"] = str(float(r["total_time_s"]) * 1e3)
@@ -258,13 +262,31 @@ def ingest_source_stats(conn: sqlite3.Connection, repo_root: Path) -> int:
     return n
 
 
+def _csv_kind(path: Path) -> Optional[str]:
+    """Schema-sniff a CSV header: ours/gen-2 session schema or the gen-1
+    export schema (e.g. the reference's root-level ``all_runs.csv``, whose
+    name a bare "summary" filter would miss)."""
+    try:
+        with open(path, newline="", errors="replace") as f:
+            header = f.readline()
+    except OSError:
+        return None
+    if "ProjectVariant" in header or ("Variant" in header and "Status" in header):
+        return "summary_csv"
+    if "version" in header and "total_time_s" in header:
+        return "summary_csv"
+    return None
+
+
 def cmd_ingest(conn: sqlite3.Connection, logs_root: Path, repo_root: Optional[Path]) -> None:
     n_csv = n_log = skipped = 0
     for path in sorted(logs_root.rglob("*")):
         if not path.is_file():
             continue
-        if path.name.endswith(".csv") and "summary" in path.name:
-            kind = "summary_csv"
+        if path.suffix == ".csv":
+            kind = _csv_kind(path)
+            if kind is None:
+                continue
         elif path.suffix == ".log":
             kind = "run_log"
         else:
@@ -285,15 +307,19 @@ def cmd_ingest(conn: sqlite3.Connection, logs_root: Path, repo_root: Optional[Pa
 
 SPEEDUP_SQL = """
 WITH base AS (
-    SELECT batch, MIN(best_ms) AS t1_ms FROM best_runs
-    WHERE variant = ? AND np = 1 GROUP BY batch
+    SELECT COALESCE(batch, 1) AS batch, MIN(best_ms) AS t1_ms FROM best_runs
+    WHERE variant = ? AND np = 1 GROUP BY COALESCE(batch, 1)
 )
 SELECT b.variant, b.np, b.batch, b.best_ms,
        base.t1_ms / b.best_ms AS speedup,
        base.t1_ms / b.best_ms / b.np AS efficiency
-FROM best_runs b JOIN base ON base.batch IS b.batch
+FROM best_runs b JOIN base ON base.batch = COALESCE(b.batch, 1)
 ORDER BY b.variant, b.batch, b.np
 """
+# batch NULL (the reference corpus has no batch column; it is batch-1 by
+# construction) is COALESCEd to 1 so historical reference rows and new
+# batch-1 TPU rows share one per-image baseline. Rows at other batch sizes
+# still require a same-batch np=1 baseline — no silent cross-batch ratios.
 
 
 def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
@@ -358,6 +384,76 @@ def cmd_plot(conn: sqlite3.Connection, out_dir: Path, baseline: str) -> None:
         print(f"wrote {out_dir / fname}")
 
 
+def cmd_report(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
+    """Markdown analysis report — the reference's ``best_runs.md`` /
+    ``analysis_exports/*_report.md`` analogue, generated from the warehouse.
+    """
+    import datetime
+
+    lines: List[str] = []
+    lines.append("# Performance analysis report")
+    lines.append("")
+    n_runs = conn.execute("SELECT COUNT(*) FROM summary_runs").fetchone()[0]
+    n_perf = conn.execute("SELECT COUNT(*) FROM perf_runs").fetchone()[0]
+    sessions = conn.execute(
+        "SELECT COUNT(DISTINCT session_id) FROM summary_runs"
+    ).fetchone()[0]
+    machines = [
+        r[0]
+        for r in conn.execute(
+            "SELECT DISTINCT machine_id FROM summary_runs WHERE machine_id IS NOT NULL"
+        )
+    ]
+    lines.append(
+        f"Generated {datetime.datetime.now(datetime.timezone.utc).strftime('%Y-%m-%d %H:%M UTC')} "
+        f"from {n_runs} ingested rows ({n_perf} OK perf runs) across "
+        f"{sessions} sessions; machines: {', '.join(machines) or 'n/a'}."
+    )
+
+    lines.append("")
+    lines.append("## Best runs (min time per variant / np / batch)")
+    lines.append("")
+    lines.append("| variant | np | batch | best_ms | img/s | n |")
+    lines.append("|---|---:|---:|---:|---:|---:|")
+    for v, np_, b, ms, n in conn.execute(
+        "SELECT variant, np, batch, best_ms, n FROM best_runs ORDER BY variant, batch, np"
+    ):
+        imgs = (b or 1) / (ms / 1e3) if ms else 0.0
+        lines.append(
+            f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {imgs:.1f} | {n} |"
+        )
+
+    lines.append("")
+    lines.append(f"## Speedup & efficiency vs `{baseline}` (np=1, same batch)")
+    lines.append("")
+    lines.append("| variant | np | batch | best_ms | S(N) | E(N) |")
+    lines.append("|---|---:|---:|---:|---:|---:|")
+    for v, np_, b, ms, s, e in conn.execute(SPEEDUP_SQL, (baseline,)):
+        lines.append(
+            f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {s:.2f} | {e:.2f} |"
+        )
+
+    lines.append("")
+    lines.append("## Run statistics (mean / stddev / 95% CI)")
+    lines.append("")
+    lines.append("| variant | np | batch | n | mean_ms | stdev_ms | ci95_ms |")
+    lines.append("|---|---:|---:|---:|---:|---:|---:|")
+    for v, np_, b, n, mean, sd, ci in conn.execute(
+        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms FROM run_stats "
+        "ORDER BY variant, batch, np"
+    ):
+        lines.append(
+            f"| {v} | {np_} | {b if b is not None else '-'} | {n} | {mean:.3f} "
+            f"| {f'{sd:.3f}' if sd is not None else '-'} "
+            f"| {f'{ci:.3f}' if ci is not None else '-'} |"
+        )
+
+    lines.append("")
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text("\n".join(lines))
+    print(f"wrote {out} ({n_perf} perf runs, {sessions} sessions)")
+
+
 VIEWS = ("perf_runs", "best_runs", "run_stats", "summary_runs", "run_logs", "source_stats")
 
 
@@ -399,6 +495,9 @@ def make_parser() -> argparse.ArgumentParser:
     pe.add_argument("--view", required=True)
     pe.add_argument("--out", required=True)
     pe.add_argument("--fmt", choices=["csv", "parquet"], default="csv")
+    pr = sub.add_parser("report", help="markdown best-runs/stats report")
+    pr.add_argument("--out", default="analysis_exports/best_runs_report.md")
+    pr.add_argument("--baseline", default="V1 Serial")
     return p
 
 
@@ -420,6 +519,8 @@ def main(argv=None) -> int:
             cmd_plot(conn, Path(args.out), args.baseline)
         elif args.cmd == "export":
             cmd_export(conn, args.view, Path(args.out), args.fmt)
+        elif args.cmd == "report":
+            cmd_report(conn, Path(args.out), args.baseline)
     finally:
         conn.close()
     return 0

@@ -47,6 +47,29 @@ def main(argv=None) -> int:
 
     from ..models.transformer import TINY_LM, init_transformer, make_lm_train_step
 
+    # Argument-compatibility checks: fail with a clean rc=2 here instead of a
+    # raw traceback from inside jit tracing (advisor finding, round 1).
+    err = None
+    if args.attn == "flash":
+        bq = min(128, args.seq_len)  # flash block size, clamped to L
+        if args.seq_len % bq:
+            err = f"--attn flash needs --seq-len divisible by {bq} (got {args.seq_len})"
+    elif args.attn in ("ring", "ulysses"):
+        if args.shards < 1:
+            err = f"--shards must be >= 1, got {args.shards}"
+        elif args.seq_len % args.shards:
+            err = f"--attn {args.attn} needs --seq-len divisible by --shards ({args.seq_len} % {args.shards} != 0)"
+        elif args.attn == "ulysses" and TINY_LM.n_heads % args.shards:
+            err = f"--attn ulysses needs --shards dividing n_heads={TINY_LM.n_heads} (got {args.shards})"
+        elif args.shards > jax.device_count():
+            err = (
+                f"--shards {args.shards} exceeds {jax.device_count()} available "
+                f"device(s) (use --fake-devices N on CPU)"
+            )
+    if err is not None:
+        print(err, file=sys.stderr)
+        return 2
+
     cfg = dataclasses.replace(
         TINY_LM,
         attn_impl=args.attn,

@@ -93,6 +93,35 @@ def output_shape(cfg: Blocks12Config = BLOCKS12) -> Tuple[int, int, int]:
     return h, w, cfg.conv2.out_channels
 
 
+def flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
+    """Exact FLOPs for one image through Blocks 1-2 (MAC = 2 FLOPs).
+
+    Counts conv MACs plus the elementwise ReLU/pool/LRN work. For the default
+    config this is ~1.12 GFLOP — note the reference's summary.md:29-45 claims
+    "~0.33 GFLOPs" for the same workload; that figure undercounts (it is not
+    reproducible from the layer dims), so we derive from the config instead.
+    """
+    h, w = cfg.in_height, cfg.in_width
+    total = 0
+    c_in = cfg.in_channels
+    for name, spec in cfg.layer_chain():
+        if isinstance(spec, ConvSpec):
+            h = conv_out_dim(h, spec.filter_size, spec.padding, spec.stride)
+            w = conv_out_dim(w, spec.filter_size, spec.padding, spec.stride)
+            macs = h * w * spec.out_channels * spec.filter_size**2 * c_in
+            total += 2 * macs + h * w * spec.out_channels  # +bias add, +ReLU
+            c_in = spec.out_channels
+        elif isinstance(spec, PoolSpec):
+            h = pool_out_dim(h, spec.window, spec.stride)
+            w = pool_out_dim(w, spec.window, spec.stride)
+            total += h * w * c_in * spec.window**2  # window max compares
+        elif isinstance(spec, LrnSpec):
+            # per element: ~size multiplies + adds for the window sum, plus
+            # the scale power and divide
+            total += h * w * c_in * (2 * spec.size + 2)
+    return total
+
+
 def forward_blocks12(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
     """Forward pass Conv1→ReLU→Pool1→Conv2→ReLU→Pool2→LRN2.
 

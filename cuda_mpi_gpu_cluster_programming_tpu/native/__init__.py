@@ -265,6 +265,7 @@ class NativeDataLoader:
         depth: int = 2,
         workers: int = 2,
     ):
+        self._handle = None  # so __del__->close is safe if init raises below
         self._shape = tuple(int(d) for d in shape)
         elems = int(np.prod(self._shape))
         self._handle = _load().dl_create(

@@ -211,7 +211,12 @@ def main(argv=None) -> int:
         # Per-layer costs of the XLA-op tier (the per-phase breakdown the
         # reference lists as future work, reference README.md:233).
         for name, ms, shape in layer_breakdown(
-            params, x, model_cfg, repeats=max(1, args.repeats), warmup=n_small
+            params,
+            x,
+            model_cfg,
+            repeats=max(1, args.repeats),
+            warmup=n_small,
+            compute=args.compute,
         ):
             shape_s = "x".join(str(d) for d in shape[1:])
             print(f"Layer {name} completed in {ms:.3f} ms -> {shape_s}")

@@ -83,13 +83,17 @@ def make_train_step(
         if mesh is None:
             return P()
         names = mesh.axis_names
-        # Batch (dp) sharding only. KNOWN ISSUE: annotating the H axis ("sp")
-        # here produces numerically wrong conv *weight* gradients from XLA's
-        # GSPMD partitioner in this JAX build (verified vs a float64 oracle:
-        # bias grads match, weight grads are garbage while the forward loss
-        # is correct). Spatial-parallel training instead goes through the
-        # explicitly-differentiable shard_map + ppermute halo path in
-        # parallel.sharded, where the collectives are ours.
+        # Batch (dp) sharding only. Spatial-parallel training goes through
+        # the explicitly-differentiable shard_map + ppermute halo path in
+        # parallel.sharded (the framework's explicit-collectives design, the
+        # reference's MPI-halo analogue) rather than a GSPMD "sp" annotation
+        # on the H axis. Round 1 additionally observed wrong conv *weight*
+        # gradients from the GSPMD partitioner with an H-axis annotation;
+        # round 2 could NOT reproduce that on cpu/jax==0.9.0 (minimal conv,
+        # full model, remat, dp x sp all give correct grads — see
+        # scripts/gspmd_conv_grad_repro.py and tests/test_gspmd_repro.py,
+        # which will fail loudly if the bug (re)appears). Behavior on the
+        # axon TPU backend is still unverified.
         return P("dp" if "dp" in names else None)
 
     def base_fwd(params, x):

@@ -91,11 +91,13 @@ def force_virtual_cpu(n_devices: int) -> None:
     ``--fake-devices`` flag. Must run before any JAX backend initializes.
 
     Splices any prior device-count flag out of XLA_FLAGS (duplicates only
-    work by last-one-wins luck) and uses ``jax.config.update`` rather than
-    the JAX_PLATFORMS env var, which the ambient sitecustomize has already
-    consumed by the time a CLI main() runs."""
+    work by last-one-wins luck), blanks PALLAS_AXON_POOL_IPS to disable the
+    ambient axon-TPU registration paths, and uses ``jax.config.update``
+    rather than the JAX_PLATFORMS env var, which the ambient sitecustomize
+    has already consumed by the time a CLI main() runs."""
     import re
 
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
     flags = re.sub(
         r"--xla_force_host_platform_device_count=\d+", "", os.environ.get("XLA_FLAGS", "")
     )

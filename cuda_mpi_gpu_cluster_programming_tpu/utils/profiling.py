@@ -96,13 +96,23 @@ def layer_breakdown(
     cfg=BLOCKS12,
     repeats: int = 10,
     warmup: int = 3,
+    compute: str = "fp32",
 ) -> List[Tuple[str, float, Tuple[int, ...]]]:
     """Fenced per-layer timing: [(layer, ms, output_shape), ...].
 
     Each layer is timed on its *actual* input (the previous layer's output,
     computed once outside the timed region), jitted standalone, with the
-    same amortized fence protocol as the headline timing.
+    same amortized fence protocol as the headline timing. ``compute='bf16'``
+    casts params and activations to bfloat16 so the breakdown matches the
+    headline timing's numerics (configs.build_forward's bf16 mode).
     """
+    if compute == "bf16":
+        import jax.numpy as jnp
+
+        params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+        x = x.astype(jnp.bfloat16)
+    elif compute != "fp32":
+        raise ValueError(f"unknown compute mode {compute!r} (fp32|bf16)")
     rows: List[Tuple[str, float, Tuple[int, ...]]] = []
     cur = x
     for name, fn in stage_fns(cfg):

@@ -1,0 +1,99 @@
+"""Re-check tool: does GSPMD H-axis sharding corrupt conv *weight* grads?
+
+Round 1 documented a workaround in
+cuda_mpi_gpu_cluster_programming_tpu/training.py (x_spec): annotating the
+spatial H axis of a conv input with a mesh axis under jit allegedly produced
+wrong weight gradients. Round 2 could NOT reproduce that on cpu/jax==0.9.0 —
+this script is the standing re-check (run it after JAX upgrades; when
+multi-chip TPU hardware is available, drop the force_virtual_cpu call to run
+the same check on the real mesh — the round-1 observation may have been
+TPU-backend-specific, which a 1-chip environment cannot settle).
+
+Run (no real devices needed; forces an 8-device virtual CPU mesh):
+
+    python scripts/gspmd_conv_grad_repro.py
+
+Exit code 0 = bug reproduced (weight grads diverge; the shard_map routing in
+training.py is numerically load-bearing, not just a design choice).
+Exit code 1 = bug NOT reproduced (the current state on cpu/jax==0.9.0; the
+GSPMD sp-annotation path could be re-enabled as far as numerics go).
+
+The paired test is tests/test_gspmd_repro.py, which fails loudly if the bug
+(re)appears on the test backend.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cuda_mpi_gpu_cluster_programming_tpu.utils.env_info import force_virtual_cpu
+
+
+def grad_mismatch(n_shards: int = 4):
+    """Returns (weight_grad_diff, bias_grad_diff, loss_diff) between the
+    unsharded oracle and the H-axis GSPMD-annotated run."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(jax.devices()[:n_shards], ("sp",))
+
+    key = jax.random.PRNGKey(0)
+    kx, kw, ky = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (2, 16, 16, 3), jnp.float32)
+    w = jax.random.normal(kw, (5, 5, 3, 8), jnp.float32) * 0.1
+    b = jnp.zeros((8,), jnp.float32)
+    y = jax.random.normal(ky, (2, 16, 16, 8), jnp.float32)
+
+    def loss_fn(params, x):
+        out = jax.lax.conv_general_dilated(
+            x,
+            params["w"],
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return jnp.mean((out + params["b"] - y) ** 2)
+
+    params = {"w": w, "b": b}
+    oracle_loss, oracle_grads = jax.value_and_grad(loss_fn)(params, x)
+
+    @jax.jit
+    def sharded_value_and_grad(params, x):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, "sp", None, None))
+        )
+        params = jax.lax.with_sharding_constraint(
+            params, NamedSharding(mesh, P())
+        )
+        return jax.value_and_grad(loss_fn)(params, x)
+
+    sh_loss, sh_grads = sharded_value_and_grad(params, x)
+
+    wdiff = float(jnp.max(jnp.abs(sh_grads["w"] - oracle_grads["w"])))
+    bdiff = float(jnp.max(jnp.abs(sh_grads["b"] - oracle_grads["b"])))
+    ldiff = float(jnp.abs(sh_loss - oracle_loss))
+    return wdiff, bdiff, ldiff
+
+
+def main() -> int:
+    force_virtual_cpu(8)
+    import jax
+
+    wdiff, bdiff, ldiff = grad_mismatch()
+    print(f"jax=={jax.__version__}  devices={jax.device_count()}x cpu")
+    print(f"forward loss  |diff| = {ldiff:.3e}  (expected ~0 either way)")
+    print(f"bias   grad max|diff| = {bdiff:.3e}  (expected ~0 either way)")
+    print(f"weight grad max|diff| = {wdiff:.3e}  (>1e-3 = bug present)")
+    if wdiff > 1e-3 and ldiff < 1e-4 and bdiff < 1e-4:
+        print("BUG REPRODUCED: H-axis GSPMD annotation corrupts conv weight grads; "
+              "keep the shard_map workaround in training.py")
+        return 0
+    print("bug NOT reproduced — the GSPMD sp-annotation path may be re-enabled "
+          "(see training.py x_spec)")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
