@@ -358,7 +358,9 @@ def cmd_plot(conn: sqlite3.Connection, out_dir: Path, baseline: str) -> None:
     out_dir.mkdir(parents=True, exist_ok=True)
     by_variant: dict = {}
     for v, np_, b, ms, s, e in rows:
-        by_variant.setdefault((v, b), []).append((np_, s, e))
+        # batch NULL = the (batch-1) reference corpus; normalize so mixed
+        # corpora sort and label consistently.
+        by_variant.setdefault((v, b if b is not None else 1), []).append((np_, s, e))
     for idx, (title, ylab, fname) in enumerate(
         [("Speedup vs serial baseline", "S(N) = T1/TN", "speedup.png"),
          ("Parallel efficiency", "E(N) = S(N)/N", "efficiency.png")]

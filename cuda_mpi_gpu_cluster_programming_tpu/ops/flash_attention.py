@@ -1,10 +1,20 @@
 """Pallas flash attention: fused online-softmax attention for TPU.
 
 The hot-op counterpart of ``ops.attention.attention`` (which materializes
-the full (L, L) score matrix in HBM): one kernel per (batch, head, q-block)
-streams K/V through VMEM in blocks, carrying the numerically-stable running
-(max, numerator, denominator) — O(L) memory instead of O(L^2), with the
-QK^T and PV matmuls on the MXU and fp32 accumulation throughout.
+the full (L, L) score matrix in HBM). Forward and backward are both O(L)
+memory:
+
+- **Forward**: grid (batch, head, q-block, k-block) — K/V are *streamed
+  through VMEM one block at a time by the grid* (only (block, D) tiles are
+  ever resident, not whole-L), carrying the numerically-stable running
+  (max, numerator, denominator) in VMEM scratch across the k-block axis.
+  QK^T and PV ride the MXU with fp32 accumulation. The forward also emits
+  the per-row log-sum-exp (LSE) for the backward.
+- **Backward**: FlashAttention-2-style recompute — no residual score
+  matrix. Two kernels: dQ (stream K/V per q-block) and dK/dV (stream Q/dO
+  per k-block), each recomputing the normalized probabilities from Q, K and
+  the saved LSE, so peak memory stays O(L·D) end to end. The O(L^2) VJP
+  fallback from round 1 is gone.
 
 Composes with the sequence-parallel tier: ``ring_attention`` shards the
 sequence *across* chips; this kernel is the *within-chip* block engine
@@ -22,15 +32,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF
-
-try:  # pltpu importable everywhere; only used for memory-space hints
-    from jax.experimental.pallas import tpu as pltpu
-
-    _VMEM = pltpu.VMEM
-except Exception:  # pragma: no cover
-    _VMEM = None
 
 
 def _interpret() -> bool:
@@ -38,53 +42,283 @@ def _interpret() -> bool:
 
 
 def _spec(block_shape, index_map):
-    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
-    return pl.BlockSpec(block_shape, index_map, **kw)
+    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int, causal: bool, scale: float):
-    """One (batch, head, q-block) program.
+def _causal_mask(s, qi, ki, bq, bk):
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
-    q_ref: (1, 1, bq, D); k_ref/v_ref: (1, 1, L, D); o_ref: (1, 1, bq, D).
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, den_sc, acc_sc, *, bq, bk, causal, scale
+):
+    """One (batch, head, q-block, k-block) program.
+
+    q_ref: (1, 1, bq, D); k_ref/v_ref: (1, 1, bk, D) — ONE k/v block, indexed
+    by the grid (streaming). Running stats live in VMEM scratch across the
+    k-block grid axis (sequential on TPU and in interpret mode).
     """
     qi = pl.program_id(2)
-    d = q_ref.shape[-1]
-    l = k_ref.shape[-2]
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, D)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    num0 = jnp.zeros((bq, d), jnp.float32)
-    den0 = jnp.zeros((bq,), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        den_sc[...] = jnp.zeros_like(den_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    def body(j, carry):
-        m, num, den = carry
-        k_blk = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (bk, D)
-        v_blk = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+    # Causal: blocks strictly above the diagonal contribute nothing — skip
+    # the math (the grid still visits them; pl.when skips the compute).
+    contributes = (not causal) or ((qi + 1) * bq - 1 >= ki * bk)
+
+    @pl.when(contributes)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, D)
+        k_blk = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
         if causal:
-            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        blk_max = jnp.max(s, axis=-1)  # (bq,)
-        m_new = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - m_new)
+            s = _causal_mask(s, qi, ki, bq, bk)
+        m_prev = m_sc[:, 0]  # (bq,)
+        den_prev = den_sc[:, 0]
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, blk_max)
+        corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])  # (bq, bk)
-        num = num * corr[:, None] + jax.lax.dot_general(
+        acc_sc[...] = acc_sc[...] * corr[:, None] + lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        den = den * corr + jnp.sum(p, axis=-1)
-        return m_new, num, den
+        den_new = den_prev * corr + jnp.sum(p, axis=-1)
+        m_sc[...] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+        den_sc[...] = jnp.broadcast_to(den_new[:, None], den_sc.shape)
 
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        m = m_sc[:, 0]
+        den = jnp.maximum(den_sc[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_sc[...] / den[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(den)
+
+
+# Lane width of the (bq,)-shaped running stats held in VMEM scratch: Mosaic
+# wants >= 2D tiles, so the vectors are broadcast across a 128-lane axis.
+_STAT_LANES = 128
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, return_lse):
+    b, l, h, d = q.shape
+    bq = min(block_q, l)
+    bk = min(block_k, l)
+    if l % bq or l % bk:
+        raise ValueError(f"sequence length {l} not divisible by blocks ({bq}, {bk})")
+    scale = 1.0 / (d**0.5)  # Python math: stays static under jit tracing
+
+    # (B, L, H, D) -> (B, H, L, D): heads become a grid axis, L contiguous.
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, l // bq, l // bk),
+        in_specs=[
+            _spec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            _spec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            _spec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            _spec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            _spec((1, 1, bq), lambda bi, hi, qi, ki: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, l), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running max
+            pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running denominator
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    out = jnp.transpose(out, (0, 2, 1, 3))
+    return (out, lse) if return_lse else out
+
+
+# ---------------------------------------------------------------------------
+# Backward (FlashAttention-2 recompute: no (L, L) residency anywhere)
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, causal, scale):
+    """Normalized probabilities for one (q-block, k-block) tile, from the
+    saved LSE: p = exp(s - lse) = softmax(s) exactly, no running max needed."""
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    k_blk = k_ref[0, 0].astype(jnp.float32)
+    s = lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
     if causal:
-        # Blocks strictly above the diagonal contribute nothing: iterate only
-        # far enough to cover this q-block's last row (dynamic trip count).
-        n_blocks = pl.cdiv((qi + 1) * bq, bk)
-    else:
-        n_blocks = l // bk
-    _, num, den = lax.fori_loop(0, n_blocks, body, (m0, num0, den0))
-    o_ref[0, 0] = (num / jnp.maximum(den, 1e-30)[:, None]).astype(o_ref.dtype)
+        s = _causal_mask(s, qi, ki, bq, bk)
+    return jnp.exp(s - lse_ref[0, 0][:, None])  # (bq, bk)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, bq, bk, causal, scale
+):
+    """dQ for one q-block, streaming K/V blocks over the last grid axis."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    contributes = (not causal) or ((qi + 1) * bq - 1 >= ki * bk)
+
+    @pl.when(contributes)
+    def _update():
+        p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, causal, scale)
+        do = do_ref[0, 0].astype(jnp.float32)  # (bq, D)
+        v_blk = v_ref[0, 0].astype(jnp.float32)  # (bk, D)
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0][:, None])  # (bq, bk)
+        dq_sc[...] += scale * lax.dot_general(
+            ds, k_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc,
+    *, bq, bk, causal, scale,
+):
+    """dK and dV for one k-block, streaming Q/dO blocks over the last grid axis."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    contributes = (not causal) or ((qi + 1) * bq - 1 >= ki * bk)
+
+    @pl.when(contributes)
+    def _update():
+        p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, causal, scale)
+        do = do_ref[0, 0].astype(jnp.float32)  # (bq, D)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        dv_sc[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, D)
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dk_sc[...] += scale * lax.dot_general(
+            ds, q_ref[0, 0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, D)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
+    b, l, h, d = q.shape
+    bq = min(block_q, l)
+    bk = min(block_k, l)
+    scale = 1.0 / (d**0.5)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    dot = jnp.transpose(out, (0, 2, 1, 3))
+    gt = jnp.transpose(g, (0, 2, 1, 3))
+
+    # delta_i = sum_d dO_i * O_i — O(L) rowwise term of dS (FA-2 eq. 4).
+    delta = jnp.sum(gt.astype(jnp.float32) * dot.astype(jnp.float32), axis=-1)  # (b,h,l)
+
+    qb = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    kb = lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+    rowq = lambda bi, hi, qi, ki: (bi, hi, qi)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=bq, bk=bk, causal=causal, scale=scale),
+        grid=(b, h, l // bq, l // bk),
+        in_specs=[
+            _spec((1, 1, bq, d), qb),
+            _spec((1, 1, bk, d), kb),
+            _spec((1, 1, bk, d), kb),
+            _spec((1, 1, bq, d), qb),
+            _spec((1, 1, bq), rowq),
+            _spec((1, 1, bq), rowq),
+        ],
+        out_specs=_spec((1, 1, bq, d), qb),
+        out_shape=jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qt, kt, vt, gt, lse, delta)
+
+    # k-block outer, q-block streamed innermost.
+    kb2 = lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+    qb2 = lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    rowq2 = lambda bi, hi, ki, qi: (bi, hi, qi)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, causal=causal, scale=scale),
+        grid=(b, h, l // bk, l // bq),
+        in_specs=[
+            _spec((1, 1, bk, d), kb2),
+            _spec((1, 1, bk, d), kb2),
+            _spec((1, 1, bq, d), qb2),
+            _spec((1, 1, bq, d), qb2),
+            _spec((1, 1, bq), rowq2),
+            _spec((1, 1, bq), rowq2),
+        ],
+        out_specs=[
+            _spec((1, 1, bk, d), kb2),
+            _spec((1, 1, bk, d), kb2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, l, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, l, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(kt, vt, qt, gt, lse, delta)
+
+    tr = lambda a: jnp.transpose(a, (0, 2, 1, 3))
+    return tr(dq), tr(dk), tr(dv)
+
+
+# ---------------------------------------------------------------------------
+# Public API + custom VJP
+# ---------------------------------------------------------------------------
 
 
 def flash_attention(
@@ -98,71 +332,36 @@ def flash_attention(
 ) -> jax.Array:
     """Fused attention. q,k,v: (B, L, H, D) -> (B, L, H, D).
 
-    ``L`` must be divisible by the (clamped) block sizes. K/V for one head
-    reside in VMEM, bounding L at roughly 16 MB / (8 B * D) per head —
-    beyond that, shard the sequence with ``parallel.sequence_parallel``.
+    ``L`` must be divisible by the (clamped) block sizes. Only (block, D)
+    K/V tiles are VMEM-resident at a time (the grid streams them), so L is
+    bounded by HBM, not VMEM.
 
-    Differentiable: the backward pass recomputes gradients with the O(L^2)
-    reference math (``ops.attention``) under a custom VJP — the fused kernel
-    accelerates the forward/inference path; training at lengths where the
-    quadratic backward is prohibitive should shard the sequence instead.
+    Differentiable with O(L)-memory: the custom VJP recomputes probabilities
+    blockwise from the saved log-sum-exp (FlashAttention-2 backward) in two
+    Pallas kernels — training at long L never materializes (L, L).
     """
     return _flash_diff(causal, block_q, block_k, q, k, v)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash_diff(causal, block_q, block_k, q, k, v):
-    return _flash_forward(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    return _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, return_lse=False
+    )
 
 
 def _flash_diff_fwd(causal, block_q, block_k, q, k, v):
-    out = _flash_forward(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, return_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, block_q, block_k, res, g):
-    from .attention import attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q, block_k=block_k
+    )
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
-
-
-def _flash_forward(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    causal: bool,
-    block_q: int,
-    block_k: int,
-) -> jax.Array:
-    b, l, h, d = q.shape
-    bq = min(block_q, l)
-    bk = min(block_k, l)
-    if l % bq or l % bk:
-        raise ValueError(f"sequence length {l} not divisible by blocks ({bq}, {bk})")
-    scale = 1.0 / (d**0.5)  # Python math: stays static under jit tracing
-
-    # (B, L, H, D) -> (B, H, L, D): heads become a grid axis, L contiguous.
-    qt = jnp.transpose(q, (0, 2, 1, 3))
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-
-    kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, causal=causal, scale=scale)
-    out = pl.pallas_call(
-        kernel,
-        grid=(b, h, l // bq),
-        in_specs=[
-            _spec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            _spec((1, 1, l, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            _spec((1, 1, l, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        ],
-        out_specs=_spec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
-        interpret=_interpret(),
-    )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3))

@@ -162,15 +162,24 @@ def launch_plan(
     script: str,
     script_args: Sequence[str] = (),
     workdir: str = "/root/repo",
+    extra_env: Optional[dict] = None,
 ) -> List[str]:
     """Render per-host launch commands (hostfile + mpirun analogue,
     2_final_multi_machine.sh:289-303,393-410). Host 0's command runs
     locally; the rest are ssh invocations — printable for dry runs,
-    executable by a deployment wrapper."""
+    executable by the deployment wrapper (``parallel.deploy``).
+
+    ``extra_env`` adds environment assignments to every host's command (the
+    ``--mca``/env-tuning analogue; e.g. the virtual-CPU variables for a
+    localhost simulation)."""
+    extras = "".join(
+        f"{k}={shlex.quote(str(v))} " for k, v in (extra_env or {}).items()
+    )
     cmds = []
     for pid, host in enumerate(cluster.hosts):
         inner = (
             f"cd {shlex.quote(workdir)} && "
+            f"{extras}"
             f"JAX_COORDINATOR_ADDRESS={cluster.coordinator_address} "
             f"JAX_NUM_PROCESSES={cluster.num_processes} "
             f"JAX_PROCESS_ID={pid} "

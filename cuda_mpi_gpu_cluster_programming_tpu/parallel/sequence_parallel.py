@@ -75,16 +75,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m_new, num, den
 
-    m0 = jnp.full((b, h, lb), NEG_INF, jnp.float32)
-    num0 = jnp.zeros((b, h, lb, d), jnp.float32)
-    den0 = jnp.zeros((b, h, lb), jnp.float32)
-    # n_shards is a Python int: the loop unrolls at trace time, so the
-    # causal source index `src` stays partially static-friendly; ppermute
-    # count is exactly n_shards (the last rotation restores ownership).
-    carry = (k, v, m0, num0, den0)
-    for t in range(n_shards):
-        carry = step(t, carry)
-    _, _, _, num, den = carry
+    # pvary: the zero/neg-inf initials are shard-invariant, but the loop
+    # carries shard-varying updates — fori_loop needs both sides typed alike.
+    m0 = lax.pvary(jnp.full((b, h, lb), NEG_INF, jnp.float32), axis_name)
+    num0 = lax.pvary(jnp.zeros((b, h, lb, d), jnp.float32), axis_name)
+    den0 = lax.pvary(jnp.zeros((b, h, lb), jnp.float32), axis_name)
+    # lax.fori_loop keeps the compiled program size O(1) in ring size (a
+    # Python loop would unroll n_shards copies of the body — fine at 8,
+    # wasteful at pod scale). The causal mask already indexes by the traced
+    # step (`src = (me - t) % n`), and the ppermute count is exactly
+    # n_shards, so the last rotation restores K/V ownership.
+    _, _, _, num, den = lax.fori_loop(0, n_shards, step, (k, v, m0, num0, den0))
     out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, Lb, D)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 

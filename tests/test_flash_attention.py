@@ -69,6 +69,69 @@ def test_jit():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("l,block_q,block_k", [(256, 64, 64), (192, 48, 64), (24, 8, 12)])
+def test_grad_matches_reference_blocked(causal, l, block_q, block_k):
+    """Pallas recompute backward vs the O(L^2) oracle, incl. non-dividing
+    block ratios and causal masking."""
+    q, k, v = qkv(jax.random.PRNGKey(7), b=2, l=l, h=2, d=32)
+    g = jax.random.normal(jax.random.PRNGKey(8), q.shape, q.dtype)
+
+    def run(fn):
+        out, vjp = jax.vjp(lambda q, k, v: fn(q, k, v), q, k, v)
+        return out, vjp(g)
+
+    want_out, want_grads = run(lambda q, k, v: attention(q, k, v, causal=causal))
+    got_out, got_grads = run(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
+    )
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out), rtol=2e-5, atol=2e-5)
+    for got, want, name in zip(got_grads, want_grads, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-5, atol=5e-5,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_backward_never_materializes_LxL():
+    """The memory claim, asserted structurally: at L=1024 the compiled
+    forward+backward contains NO (L, L) tensor anywhere (the round-1 VJP
+    fallback materialized f32[...,1024,1024] score/grad matrices — at the
+    lengths this kernel exists for, that is OOM by construction)."""
+    l = 1024
+    q, k, v = qkv(jax.random.PRNGKey(9), b=1, l=l, h=1, d=32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    lowered = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, k, v)
+    hlo = lowered.compile().as_text()
+    assert f"{l},{l}" not in hlo, "compiled grad materializes an (L, L) tensor"
+    # sanity: the same probe DOES flag the quadratic reference path
+    ref_hlo = (
+        jax.jit(jax.grad(lambda q, k, v: jnp.sum(attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)))
+        .lower(q, k, v)
+        .compile()
+        .as_text()
+    )
+    assert f"{l},{l}" in ref_hlo
+
+
+def test_forward_lse_matches_reference():
+    """The saved LSE (backward residual) equals log-sum-exp of the true
+    scaled scores."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.flash_attention import _flash_forward
+
+    b, l, h, d = 2, 128, 2, 16
+    q, k, v = qkv(jax.random.PRNGKey(10), b=b, l=l, h=h, d=d)
+    _, lse = _flash_forward(q, k, v, causal=False, block_q=64, block_k=32, return_lse=True)
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    want = jax.scipy.special.logsumexp(s, axis=-1)  # (b,h,l)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
 def test_grad_matches_reference():
     q, k, v = qkv(jax.random.PRNGKey(4), b=1, l=64, h=2, d=16)
 

@@ -42,6 +42,43 @@ def test_classify_startup_chatter_does_not_mask_failure():
     assert harness.classify(1, text) == harness.FAIL
 
 
+def test_classify_axon_backend_error_is_env_warn():
+    # Observed round 1 (BENCH_r01.json): axon registers but init fails.
+    text = (
+        "Traceback (most recent call last):\n"
+        "  ...\n"
+        "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE: "
+        "TPU backend setup/compile error (Unavailable).\n"
+        "--------------------\n"
+        "For simplicity, JAX has removed its internal frames from the "
+        "traceback of the following exception.\n"
+    )
+    assert harness.classify(1, text) == harness.ENV_WARN
+
+
+def test_classify_wedged_tunnel_timeout_is_env_warn():
+    # Observed round 1 (MULTICHIP_r01.json, rc=124): the axon banner prints,
+    # then execution blocks forever until the timeout wrapper kills the run.
+    banner = (
+        "WARNING:jax._src.xla_bridge:905: Platform 'axon' is experimental "
+        "and not all JAX functionality may be correctly supported!\n"
+    )
+    assert harness.classify(124, banner) == harness.ENV_WARN
+    assert harness.classify_timeout(banner) == harness.ENV_WARN
+
+
+def test_classify_timeout_with_progress_is_real_timeout():
+    # A run that got past compilation before the deadline genuinely timed
+    # out — the axon banner alone must not excuse it.
+    text = (
+        "Platform 'axon' is experimental\n"
+        "Compile time: 2000.0 ms\n"
+    )
+    assert harness.classify_timeout(text) == harness.TIMEOUT
+    # and a bare kill with no wedge signature stays TIMEOUT too
+    assert harness.classify(124, "some unrelated output") == harness.TIMEOUT
+
+
 def test_parse_run_log_full():
     r = harness.CaseResult("V1 Serial", "v1_jit", 1, 1)
     r.run_status = harness.OK
