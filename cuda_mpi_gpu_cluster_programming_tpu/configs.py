@@ -91,6 +91,15 @@ REGISTRY: Dict[str, ExecConfig] = {
             "halo",
             "row-sharded, Pallas per shard, device-to-device ppermute halos over ICI",
         ),
+        ExecConfig(
+            "v7_tp",
+            "V7 TensorParallel",
+            "reference",
+            "tp",
+            "conv filter-bank (K-axis) decomposition — the reference's named-"
+            "but-unbuilt alternative to row decomposition (README.md:638); "
+            "weights sharded, channel-halo LRN, boundary all_gather",
+        ),
         # V6 family: the reference's explicit extension task (README.md:19) —
         # full AlexNet through conv5 + FC6-8 (dims summary.md:29-45).
         ExecConfig(
@@ -216,5 +225,10 @@ def _build_forward_fp32(
             tier=exec_cfg.tier,
             staged=(exec_cfg.strategy == "staged_halo"),
         )
+
+    if exec_cfg.strategy == "tp":
+        from .parallel.tensor_parallel import build_tp_forward
+
+        return build_tp_forward(model_cfg, n_shards, mesh=mesh)
 
     raise ValueError(f"unknown strategy {exec_cfg.strategy!r}")

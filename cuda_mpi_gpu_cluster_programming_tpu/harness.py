@@ -396,8 +396,8 @@ def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cuda_mpi_gpu_cluster_programming_tpu.harness")
     p.add_argument(
         "--configs",
-        default="v1_jit,v2.1_replicated,v2.2_sharded,v3_pallas,v4_hybrid,v5_collective",
-        help="comma-separated config keys (default: full V1-V5 matrix)",
+        default="v1_jit,v2.1_replicated,v2.2_sharded,v3_pallas,v4_hybrid,v5_collective,v7_tp",
+        help="comma-separated config keys (default: full V1-V7 matrix)",
     )
     p.add_argument("--shards", default="1,2,4", help="comma-separated shard counts (np sweep)")
     p.add_argument("--batches", default="1", help="comma-separated batch sizes")

@@ -32,6 +32,7 @@ import os
 import re
 import shlex
 import shutil
+import signal
 import socket
 import subprocess
 import sys
@@ -167,6 +168,9 @@ def deploy_and_collect(
     cmds = launch_plan(cluster, script, script_args, workdir=workdir, extra_env=extra_env)
 
     if dry_run:
+        if sync_from:
+            for host, action in sync_code(cluster, sync_from, workdir, dry_run=True):
+                print(f"sync {host}: {action}")
         for (h, cmd) in zip(cluster.hosts, cmds):
             print(f"[{h.host}] {cmd}")
         return [
@@ -179,30 +183,61 @@ def deploy_and_collect(
             print(f"sync {host}: {action}")
 
     session_dir.mkdir(parents=True, exist_ok=True)
-    procs: List[Tuple[int, HostSpec, subprocess.Popen, Path]] = []
+    # 5-tuples: the open log handle rides along so it stays open until after
+    # wait() (the child writes through it) and is closed before the parse.
+    procs: List[Tuple[int, HostSpec, subprocess.Popen, Path, "object"]] = []
     for pid, (h, cmd) in enumerate(zip(cluster.hosts, cmds)):
         log_path = session_dir / f"host{pid}_{h.host.replace(':', '_')}.log"
-        if is_local(h) and cmd.startswith("ssh "):
-            # launch_plan renders ssh for pid>0; strip it for local hosts
-            # (the degenerate localhost cluster / missing-sshd case).
-            cmd = shlex.split(cmd)[-1]
-        argv = ["bash", "-c", cmd] if is_local(h) else shlex.split(cmd)
+        # launch_plan renders pid 0 bare (assumed-local coordinator) and
+        # pid>0 with ssh; re-derive the transport from what the host IS:
+        # local hosts run through a shell, remote ones through ssh —
+        # whichever form launch_plan rendered.
+        if is_local(h):
+            if cmd.startswith("ssh "):
+                cmd = shlex.split(cmd)[-1]
+            argv = ["bash", "-c", cmd]
+        elif cmd.startswith("ssh "):
+            argv = shlex.split(cmd)
+        else:  # remote host in slot 0: wrap the bare command ourselves
+            argv = ["ssh", "-o", "BatchMode=yes", h.ssh_target, cmd]
         f = open(log_path, "w")
         f.write(f"$ {cmd}\n")
         f.flush()
-        procs.append(
-            (pid, h, subprocess.Popen(argv, stdout=f, stderr=subprocess.STDOUT, text=True), log_path, f)
-        )
+        try:
+            # New session so a timeout can kill the whole process group
+            # (bash/ssh wrapper AND the python worker beneath it).
+            p = subprocess.Popen(
+                argv, stdout=f, stderr=subprocess.STDOUT, text=True,
+                start_new_session=True,
+            )
+        except FileNotFoundError as e:  # e.g. no ssh binary on this machine
+            f.write(f"launch failed: {e}\n")
+            f.close()
+            p = None
+        procs.append((pid, h, p, log_path, f))
 
     results: List[HostResult] = []
     deadline = time.monotonic() + timeout_s
     for pid, h, p, log_path, f in procs:
+        if p is None:
+            text = log_path.read_text(errors="replace")
+            results.append(
+                HostResult(
+                    host=h.host, process_id=pid, status=UNREACHABLE,
+                    log_file=str(log_path),
+                    tail="\n".join(text.strip().splitlines()[-3:]),
+                )
+            )
+            continue
         left = max(0.1, deadline - time.monotonic())
         try:
             rc = p.wait(timeout=left)
             status = OK if rc == 0 else FAIL
         except subprocess.TimeoutExpired:
-            p.kill()
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
             p.wait()
             rc, status = None, TIMEOUT
         f.close()

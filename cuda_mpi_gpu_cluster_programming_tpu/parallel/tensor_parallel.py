@@ -1,0 +1,124 @@
+"""Tensor parallelism: conv output-channel (K-axis) filter decomposition.
+
+The parallelism family the reference names but never builds — "filter
+decomposition" is listed as the alternative to its row decomposition
+(reference README.md:638; SURVEY §2.2 marks TP "no — optional extension:
+shard K axis of conv"). Where ``parallel.sharded`` splits the *spatial* H
+axis (halos in image rows), this splits the *filter bank*: each shard owns
+K/n output channels of every conv layer, so weights — not activations — are
+what's partitioned. The two strategies are duals:
+
+- row-sharding: activations sharded, weights replicated, halos in H;
+- TP: weights sharded, activations replicated at layer boundaries, the
+  "halo" rotated onto the channel axis (the LRN's cross-channel window
+  needs ``size//2`` neighbor channels — exchanged with the same paired
+  ``ppermute`` shifts the row pipeline uses for image rows).
+
+Boundary collectives: one ``all_gather`` over channels after block 1
+(conv2 consumes *all* of conv1's channels), one channel-halo ``ppermute``
+pair before the LRN, and the shard_map output sharding assembles the final
+channel-sharded result. Everything rides ICI.
+
+Numerics: each output channel's dot products are computed by exactly one
+shard with the same reduction order as the single-device pass, so the TP
+forward is bit-exact vs ``forward_blocks12`` (tested at n ∈ {1,2,4,8} —
+the same shard-vs-single discipline as the row pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.alexnet import BLOCKS12, Blocks12Config
+from ..ops.reference import conv2d, lrn, maxpool, relu
+from .mesh import make_mesh
+
+
+def _channel_halo(z: jax.Array, half: int, axis_name: str, n_shards: int) -> jax.Array:
+    """Attach ``half`` neighbor channels on each side of the local slice.
+
+    Ring-edge shards receive ppermute's zero fill — equivalent to the LRN's
+    clipped-window edge semantics, since the window sums squares and the
+    zero channels contribute nothing (ops.reference.lrn edge behavior).
+    """
+    fwd = [(i, i + 1) for i in range(n_shards - 1)]  # shard i -> i+1
+    bwd = [(i + 1, i) for i in range(n_shards - 1)]
+    left = lax.ppermute(z[..., -half:], axis_name, fwd)  # prev shard's last channels
+    right = lax.ppermute(z[..., :half], axis_name, bwd)  # next shard's first channels
+    return jnp.concatenate([left, z, right], axis=-1)
+
+
+def build_tp_forward(
+    model_cfg: Blocks12Config = BLOCKS12,
+    n_shards: int = 1,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "tp",
+) -> Callable:
+    """Jitted ``(params, x) -> out`` with conv filters K-sharded n ways."""
+    cfg = model_cfg
+    for name, spec in (("conv1", cfg.conv1), ("conv2", cfg.conv2)):
+        if spec.out_channels % n_shards:
+            raise ValueError(
+                f"{name} K={spec.out_channels} not divisible by {n_shards} TP shards"
+            )
+    half = cfg.lrn2.size // 2
+    local2 = cfg.conv2.out_channels // n_shards
+    if n_shards > 1 and local2 < half:
+        raise ValueError(
+            f"LRN window half-width {half} exceeds the {local2} local channels "
+            f"at {n_shards} shards — channel halo would need multi-hop"
+        )
+    if mesh is None:
+        mesh = make_mesh(n_shards, axis_name=axis_name)
+    else:
+        axis_name = mesh.axis_names[-1]
+        axis_size = mesh.devices.shape[-1]
+        if axis_size != n_shards:
+            raise ValueError(
+                f"mesh axis {axis_name!r} has {axis_size} devices but "
+                f"tp n_shards={n_shards}; the filter slices would not line up"
+            )
+
+    def local(params, x):
+        p1, p2 = params["conv1"], params["conv2"]
+        # Block 1 on this shard's filter slice: (B, h, w, K1/n).
+        y = relu(conv2d(x, p1["w"], p1["b"], stride=cfg.conv1.stride, padding=cfg.conv1.padding))
+        y = maxpool(y, window=cfg.pool1.window, stride=cfg.pool1.stride)
+        # conv2 needs every conv1 channel: gather the channel axis (the TP
+        # boundary collective — activations are small here, 27x27x96).
+        y = lax.all_gather(y, axis_name, axis=3, tiled=True)
+        z = relu(conv2d(y, p2["w"], p2["b"], stride=cfg.conv2.stride, padding=cfg.conv2.padding))
+        z = maxpool(z, window=cfg.pool2.window, stride=cfg.pool2.stride)
+        # LRN crosses channels: exchange `half` neighbor channels, normalize,
+        # keep the owned slice.
+        if n_shards > 1:
+            zp = _channel_halo(z, half, axis_name, n_shards)
+        else:
+            zp = z
+        zl = lrn(
+            zp,
+            size=cfg.lrn2.size,
+            alpha=cfg.lrn2.alpha,
+            beta=cfg.lrn2.beta,
+            k=cfg.lrn2.k,
+            alpha_over_size=cfg.lrn2.alpha_over_size,
+        )
+        return zl[..., half:-half] if n_shards > 1 else zl
+
+    wspec = P(None, None, None, axis_name)  # HWIO: shard the O axis
+    pspec = {
+        "conv1": {"w": wspec, "b": P(axis_name)},
+        "conv2": {"w": wspec, "b": P(axis_name)},
+    }
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(None, None, None, axis_name),
+    )
+    return jax.jit(fn)

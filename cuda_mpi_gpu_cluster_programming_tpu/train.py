@@ -26,6 +26,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", choices=["sgd", "adam"], default="sgd")
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis size")
     p.add_argument("--sp", type=int, default=0, help="spatial/context-parallel shards (0 = off)")
+    p.add_argument("--tp", type=int, default=0, help="tensor-parallel (K-axis) shards (0 = off)")
     p.add_argument("--remat", action="store_true", help="rematerialize activations in backward")
     p.add_argument("--height", type=int, default=63, help="input H (default small for fast demo)")
     p.add_argument("--width", type=int, default=63)
@@ -69,10 +70,17 @@ def main(argv=None) -> int:
         print(f"degenerate model for H={args.height} W={args.width}", file=sys.stderr)
         return 2
 
-    n_devices_needed = max(1, args.dp) * max(1, args.sp or 1)
+    if args.sp and args.tp:
+        print("--sp and --tp are mutually exclusive strategies", file=sys.stderr)
+        return 2
+    if args.tp and args.dp > 1:
+        print("--tp does not compose with --dp yet (TP builds its own 1-D mesh)", file=sys.stderr)
+        return 2
+    model_shards = args.sp or args.tp or 1
+    n_devices_needed = max(1, args.dp) * model_shards
     if jax.device_count() < n_devices_needed:
         print(
-            f"need {n_devices_needed} devices (dp={args.dp} x sp={args.sp or 1}), "
+            f"need {n_devices_needed} devices (dp={args.dp} x shards={model_shards}), "
             f"have {jax.device_count()}; use --fake-devices on CPU",
             file=sys.stderr,
         )
@@ -83,7 +91,8 @@ def main(argv=None) -> int:
         mesh = make_mesh(args.sp or 1, dp=args.dp)
     opt = optax.adam(args.lr) if args.optimizer == "adam" else optax.sgd(args.lr)
     opt_init, step_fn = make_train_step(
-        cfg, mesh=mesh, optimizer=opt, sp_shards=args.sp, remat=args.remat
+        cfg, mesh=mesh, optimizer=opt, sp_shards=args.sp, tp_shards=args.tp,
+        remat=args.remat,
     )
 
     teacher = init_params_deterministic(cfg)

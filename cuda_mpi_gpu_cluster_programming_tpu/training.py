@@ -25,6 +25,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation | None = None,
     lr: float = 1e-3,
     sp_shards: int = 0,
+    tp_shards: int = 0,
     remat: bool = False,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for any optax optimizer (default SGD).
@@ -43,7 +44,14 @@ def make_train_step(
     gradients in this JAX build (see x_spec note below); shard_map's
     collectives have exact transposes (ppermute^T = reverse permute,
     replicated-in^T = psum), so gradients here are correct by construction.
+
+    ``tp_shards >= 1`` routes the forward through the K-axis filter
+    decomposition (parallel.tensor_parallel): conv weights sharded over the
+    mesh's last axis, gradients flow through the same explicit collectives
+    (all_gather^T = dynamic-slice+psum, channel-ppermute^T = reverse shift).
     """
+    if sp_shards and tp_shards:
+        raise ValueError("sp_shards and tp_shards are mutually exclusive strategies")
     opt = optimizer if optimizer is not None else optax.sgd(lr)
 
     def _build_step(loss_fn, pre=None, post=None):
@@ -78,6 +86,18 @@ def make_train_step(
             return jnp.mean((sharded_fwd(params, x) - y) ** 2)
 
         return opt.init, _build_step(sp_loss_fn)
+
+    if tp_shards and tp_shards >= 1:
+        from .parallel.tensor_parallel import build_tp_forward
+
+        tp_fwd = build_tp_forward(cfg, n_shards=tp_shards, mesh=mesh)
+        if remat:
+            tp_fwd = jax.checkpoint(tp_fwd)
+
+        def tp_loss_fn(params, x, y):
+            return jnp.mean((tp_fwd(params, x) - y) ** 2)
+
+        return opt.init, _build_step(tp_loss_fn)
 
     def x_spec() -> P:
         if mesh is None:
