@@ -39,15 +39,35 @@ BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "200"))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 BENCH_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", "900"))
-# TPU v5e: 197 TFLOP/s bf16 MXU peak. fp32 runs are also judged against this
-# (conservative: the real fp32 ceiling is lower, so true fp32 MFU is higher).
-PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 
-_PROBE_SRC = (
-    "import jax, jax.numpy as jnp;"
-    "d = jax.devices()[0];"
-    "v = float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum());"
-    "print('PROBE_OK', d.platform, v)"
+# bf16 MXU peak TFLOP/s by TPU generation (public spec sheets), matched
+# against jax's device_kind string. fp32 runs are also judged against the
+# bf16 peak (conservative: the real fp32 ceiling is lower, so true fp32 MFU
+# is higher). BENCH_PEAK_TFLOPS overrides; the assumed peak is emitted in
+# the JSON so the ratio is auditable.
+_PEAK_TABLE = [
+    ("v6", 918.0),  # v6e / Trillium
+    ("v5p", 459.0),
+    ("v5", 197.0),  # v5e — device_kind here reports "TPU v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def peak_tflops(device_kind: str) -> float:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = device_kind.lower()
+    for marker, peak in _PEAK_TABLE:
+        if marker in kind:
+            return peak
+    return 197.0  # unknown kind: assume the chip we actually develop on
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import (  # noqa: E402
+    PROBE_SRC as _PROBE_SRC,
 )
 
 
@@ -73,14 +93,22 @@ def _child() -> int:
     import jax
 
     from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
-    from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import flops_per_image
+    from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import (
+        flops_per_image,
+        matmul_flops_per_image,
+    )
     from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
         deterministic_input,
         init_params_deterministic,
     )
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.compile_cache import (
+        enable_persistent_cache,
+    )
     from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_ms
 
-    platform = jax.devices()[0].platform
+    enable_persistent_cache()
+    device = jax.devices()[0]
+    platform = device.platform
     params = init_params_deterministic()
     x = deterministic_input(batch=BATCH)
     fwd = build_forward(REGISTRY[CONFIG], compute=COMPUTE)
@@ -90,9 +118,12 @@ def _child() -> int:
     per_pass_ms = amortized_ms(fwd, params, x, n_small=10, n_large=10 + REPEATS)
     img_per_sec = BATCH / (per_pass_ms / 1e3)
     flops = flops_per_image()
-    # MFU only against a known accelerator peak; on CPU it is meaningless.
+    mxu_flops = matmul_flops_per_image()
+    peak = peak_tflops(device.device_kind)
+    # Conventional MFU: matmul-only FLOPs over the chip's bf16 MXU peak.
+    # Meaningless on CPU (no known peak), so null there.
     mfu = (
-        round(img_per_sec * flops / (PEAK_TFLOPS * 1e12), 4)
+        round(img_per_sec * mxu_flops / (peak * 1e12), 4)
         if platform != "cpu"
         else None
     )
@@ -104,7 +135,10 @@ def _child() -> int:
                 "unit": "img/s",
                 "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
                 "mfu": mfu,
+                "assumed_peak_tflops": peak if platform != "cpu" else None,
+                "device_kind": device.device_kind,
                 "flops_per_image": flops,
+                "matmul_flops_per_image": mxu_flops,
                 "platform": platform,
                 "config": CONFIG,
                 "compute": COMPUTE,
@@ -118,25 +152,13 @@ def _child() -> int:
 def main() -> int:
     here = os.path.dirname(os.path.abspath(__file__))
     # 1) Bounded device probe: a wedged tunnel hangs on the tiniest matmul.
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-u", "-c", _PROBE_SRC],
-            capture_output=True,
-            text=True,
-            timeout=PROBE_TIMEOUT,
-            cwd=here,
-        )
-    except subprocess.TimeoutExpired:
-        print(_error_json(f"device probe timed out after {PROBE_TIMEOUT:.0f}s (wedged tunnel?)"))
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
+
+    ok, info = probe(PROBE_TIMEOUT)
+    if not ok:
+        print(_error_json(f"device {info}"))
         return 0
-    ok_line = next(
-        (l for l in probe.stdout.splitlines() if l.startswith("PROBE_OK")), None
-    )
-    if probe.returncode != 0 or ok_line is None:
-        tail = (probe.stderr or probe.stdout).strip().splitlines()[-1:] or ["no output"]
-        print(_error_json(f"device probe failed (rc={probe.returncode}): {tail[0]}"))
-        return 0
-    platform = ok_line.split()[1]
+    platform = info
 
     # 2) Bounded measurement run; relay its JSON line.
     try:

@@ -91,7 +91,7 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
             variant TEXT, config_key TEXT, np INTEGER, batch INTEGER,
             build_status TEXT, run_status TEXT, parse_status TEXT, status TEXT,
             time_ms REAL, compile_ms REAL, shape TEXT, first5 TEXT,
-            log_file TEXT, src_csv TEXT
+            log_file TEXT, src_csv TEXT, corpus TEXT
         );
         CREATE TABLE IF NOT EXISTS run_logs (
             path TEXT, session_id TEXT, time_ms REAL, shape TEXT
@@ -99,20 +99,39 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
         CREATE TABLE IF NOT EXISTS source_stats (
             path TEXT PRIMARY KEY, loc INTEGER, lang TEXT
         );
-        CREATE VIEW IF NOT EXISTS perf_runs AS
+        """
+    )
+    # Migration for warehouses created before the corpus column existed:
+    # add it (NULL rows fall through to the view's src_csv heuristic). Must
+    # run before the views below, which reference the column.
+    cols = {r[1] for r in conn.execute("PRAGMA table_info(summary_runs)")}
+    if "corpus" not in cols:  # pragma: no cover — legacy DB only
+        conn.execute("ALTER TABLE summary_runs ADD COLUMN corpus TEXT")
+    conn.executescript(
+        """
+        DROP VIEW IF EXISTS perf_runs;
+        DROP VIEW IF EXISTS best_runs;
+        DROP VIEW IF EXISTS run_stats;
+        CREATE VIEW perf_runs AS
             SELECT session_id, machine_id, git_commit, variant, config_key,
-                   np, batch, time_ms, compile_ms, shape
+                   np, batch, time_ms, compile_ms, shape,
+                   COALESCE(corpus,
+                       CASE WHEN src_csv LIKE '%/reference/%'
+                              OR src_csv LIKE '%reference_import%'
+                            THEN 'reference' ELSE 'local' END) AS corpus
             FROM summary_runs
             WHERE status = 'OK' AND time_ms IS NOT NULL;
-        CREATE VIEW IF NOT EXISTS best_runs AS
-            SELECT variant, np, batch, MIN(time_ms) AS best_ms, COUNT(*) AS n
-            FROM perf_runs GROUP BY variant, np, batch;
-        CREATE VIEW IF NOT EXISTS run_stats AS
+        CREATE VIEW best_runs AS
+            SELECT variant, np, batch, MIN(time_ms) AS best_ms, COUNT(*) AS n,
+                   corpus
+            FROM perf_runs GROUP BY corpus, variant, np, batch;
+        CREATE VIEW run_stats AS
             SELECT variant, np, batch, COUNT(*) AS n,
                    AVG(time_ms) AS mean_ms,
                    stddev_samp(time_ms) AS stdev_ms,
-                   1.96 * stddev_samp(time_ms) / SQRT(COUNT(*)) AS ci95_ms
-            FROM perf_runs GROUP BY variant, np, batch;
+                   1.96 * stddev_samp(time_ms) / SQRT(COUNT(*)) AS ci95_ms,
+                   corpus
+            FROM perf_runs GROUP BY corpus, variant, np, batch;
         """
     )
     return conn
@@ -151,8 +170,17 @@ _REF_GEN2_MAP = {
 
 
 def _normalize_row(r: dict) -> dict:
+    """Normalise one CSV row to our column names and tag its corpus.
+
+    The corpus ('reference' vs 'local') is decided by SCHEMA, not by file
+    path: both reference schema generations are unmistakable from their
+    headers, so reference CSVs copied anywhere (a tmp logs tree, a
+    reference_import staging dir) still classify correctly. The per-corpus
+    speedup baseline depends on this tag.
+    """
     if "ProjectVariant" in r:  # reference gen-2 session schema
         out = dict(r)
+        out["_corpus"] = "reference"
         for src, dst in _REF_GEN2_MAP.items():
             if src in out:
                 out[dst] = out.pop(src)
@@ -171,6 +199,7 @@ def _normalize_row(r: dict) -> dict:
         return out
     if "version" in r and "total_time_s" in r:  # reference gen-1 export schema
         out = {
+            "_corpus": "reference",
             "Timestamp": r.get("ts"),
             "Variant": r.get("version"),
             "NP": r.get("np"),
@@ -195,7 +224,7 @@ def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
     n = 0
     for r in rows:
         conn.execute(
-            "INSERT INTO summary_runs VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            "INSERT INTO summary_runs VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
             (
                 r.get("SessionID"),
                 r.get("MachineID"),
@@ -215,6 +244,7 @@ def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
                 r.get("First5Values"),
                 r.get("LogFile"),
                 str(path),
+                r.get("_corpus", "local"),
             ),
         )
         n += 1
@@ -307,19 +337,28 @@ def cmd_ingest(conn: sqlite3.Connection, logs_root: Path, repo_root: Optional[Pa
 
 SPEEDUP_SQL = """
 WITH base AS (
-    SELECT COALESCE(batch, 1) AS batch, MIN(best_ms) AS t1_ms FROM best_runs
-    WHERE variant = ? AND np = 1 GROUP BY COALESCE(batch, 1)
+    SELECT corpus, COALESCE(batch, 1) AS batch, MIN(best_ms) AS t1_ms
+    FROM best_runs
+    WHERE variant = ? AND np = 1 GROUP BY corpus, COALESCE(batch, 1)
 )
 SELECT b.variant, b.np, b.batch, b.best_ms,
        base.t1_ms / b.best_ms AS speedup,
-       base.t1_ms / b.best_ms / b.np AS efficiency
-FROM best_runs b JOIN base ON base.batch = COALESCE(b.batch, 1)
-ORDER BY b.variant, b.batch, b.np
+       base.t1_ms / b.best_ms / b.np AS efficiency,
+       b.corpus
+FROM best_runs b
+JOIN base ON base.corpus = b.corpus AND base.batch = COALESCE(b.batch, 1)
+ORDER BY b.corpus, b.variant, b.batch, b.np
 """
 # batch NULL (the reference corpus has no batch column; it is batch-1 by
 # construction) is COALESCEd to 1 so historical reference rows and new
 # batch-1 TPU rows share one per-image baseline. Rows at other batch sizes
 # still require a same-batch np=1 baseline — no silent cross-batch ratios.
+# The baseline T1 is additionally grouped PER CORPUS (reference-ingested
+# CSVs vs this repo's own sessions, derived from src_csv origin): the
+# reference's hardware and this repo's TPU must each be judged against
+# their own serial baseline — mirroring log_analysis.py:213-222, which
+# only ever saw one corpus. Cross-corpus comparison stays available via
+# the raw best_runs view (both corpora share the variant axis).
 
 
 def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
@@ -327,22 +366,33 @@ def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
     if not rows:
         print(f"no data (is there a '{baseline}' np=1 run ingested?)", file=sys.stderr)
         return []
-    print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'best_ms':>10s} {'S(N)':>7s} {'E(N)':>6s}")
-    for v, np_, b, ms, s, e in rows:
+    print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'best_ms':>10s} {'S(N)':>7s} {'E(N)':>6s} {'corpus':>9s}")
+    for v, np_, b, ms, s, e, corpus in rows:
         # batch is NULL for reference-corpus rows (the reference is batch-1
         # with no batch column).
-        print(f"{v:22s} {np_:3d} {str(b) if b is not None else '-':>5s} {ms:10.3f} {s:7.2f} {e:6.2f}")
+        print(
+            f"{v:22s} {np_:3d} {str(b) if b is not None else '-':>5s} "
+            f"{ms:10.3f} {s:7.2f} {e:6.2f} {corpus:>9s}"
+        )
     return rows
 
 
 def cmd_stats(conn: sqlite3.Connection) -> None:
     rows = conn.execute(
-        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms FROM run_stats "
-        "ORDER BY variant, batch, np"
+        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms, corpus FROM run_stats "
+        "ORDER BY corpus, variant, batch, np"
     ).fetchall()
-    print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'n':>4s} {'mean_ms':>10s} {'stdev':>8s} {'ci95':>8s}")
-    for v, np_, b, n, mean, sd, ci in rows:
-        print(f"{v:22s} {np_:3d} {b:5d} {n:4d} {mean:10.3f} {sd or 0:8.3f} {ci or 0:8.3f}")
+    print(
+        f"{'variant':22s} {'np':>3s} {'batch':>5s} {'n':>4s} {'mean_ms':>10s} "
+        f"{'stdev':>8s} {'ci95':>8s} {'corpus':>9s}"
+    )
+    for v, np_, b, n, mean, sd, ci, corpus in rows:
+        # batch NULL = the (batch-1) reference corpus; '-' like the other
+        # commands, never a fabricated 0.
+        print(
+            f"{v:22s} {np_:3d} {str(b) if b is not None else '-':>5s} {n:4d} "
+            f"{mean:10.3f} {sd or 0:8.3f} {ci or 0:8.3f} {corpus:>9s}"
+        )
 
 
 def cmd_plot(conn: sqlite3.Connection, out_dir: Path, baseline: str) -> None:
@@ -356,21 +406,26 @@ def cmd_plot(conn: sqlite3.Connection, out_dir: Path, baseline: str) -> None:
         print("no data to plot", file=sys.stderr)
         return
     out_dir.mkdir(parents=True, exist_ok=True)
+    corpora = {r[6] for r in rows}
     by_variant: dict = {}
-    for v, np_, b, ms, s, e in rows:
+    for v, np_, b, ms, s, e, corpus in rows:
         # batch NULL = the (batch-1) reference corpus; normalize so mixed
-        # corpora sort and label consistently.
-        by_variant.setdefault((v, b if b is not None else 1), []).append((np_, s, e))
+        # corpora sort and label consistently. Corpus only appears in the
+        # label when the warehouse actually holds more than one.
+        label = f"{v} (b={b if b is not None else 1})"
+        if len(corpora) > 1:
+            label += f" [{corpus}]"
+        by_variant.setdefault(label, []).append((np_, s, e))
     for idx, (title, ylab, fname) in enumerate(
         [("Speedup vs serial baseline", "S(N) = T1/TN", "speedup.png"),
          ("Parallel efficiency", "E(N) = S(N)/N", "efficiency.png")]
     ):
         fig, ax = plt.subplots(figsize=(7, 4.5))
-        for (v, b), pts in sorted(by_variant.items()):
+        for label, pts in sorted(by_variant.items()):
             pts.sort()
             xs = [p[0] for p in pts]
             ys = [p[1 + idx] for p in pts]
-            ax.plot(xs, ys, marker="o", label=f"{v} (b={b})")
+            ax.plot(xs, ys, marker="o", label=label)
         if idx == 0:
             lim = max(p[0] for pts in by_variant.values() for p in pts)
             ax.plot([1, lim], [1, lim], "k--", alpha=0.4, label="ideal")
@@ -415,39 +470,42 @@ def cmd_report(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
     lines.append("")
     lines.append("## Best runs (min time per variant / np / batch)")
     lines.append("")
-    lines.append("| variant | np | batch | best_ms | img/s | n |")
-    lines.append("|---|---:|---:|---:|---:|---:|")
-    for v, np_, b, ms, n in conn.execute(
-        "SELECT variant, np, batch, best_ms, n FROM best_runs ORDER BY variant, batch, np"
+    lines.append("| variant | np | batch | best_ms | img/s | n | corpus |")
+    lines.append("|---|---:|---:|---:|---:|---:|---|")
+    for v, np_, b, ms, n, corpus in conn.execute(
+        "SELECT variant, np, batch, best_ms, n, corpus FROM best_runs "
+        "ORDER BY corpus, variant, batch, np"
     ):
         imgs = (b or 1) / (ms / 1e3) if ms else 0.0
         lines.append(
-            f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {imgs:.1f} | {n} |"
+            f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {imgs:.1f} "
+            f"| {n} | {corpus} |"
         )
 
     lines.append("")
-    lines.append(f"## Speedup & efficiency vs `{baseline}` (np=1, same batch)")
+    lines.append(f"## Speedup & efficiency vs `{baseline}` (np=1, same batch, same corpus)")
     lines.append("")
-    lines.append("| variant | np | batch | best_ms | S(N) | E(N) |")
-    lines.append("|---|---:|---:|---:|---:|---:|")
-    for v, np_, b, ms, s, e in conn.execute(SPEEDUP_SQL, (baseline,)):
+    lines.append("| variant | np | batch | best_ms | S(N) | E(N) | corpus |")
+    lines.append("|---|---:|---:|---:|---:|---:|---|")
+    for v, np_, b, ms, s, e, corpus in conn.execute(SPEEDUP_SQL, (baseline,)):
         lines.append(
-            f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {s:.2f} | {e:.2f} |"
+            f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {s:.2f} "
+            f"| {e:.2f} | {corpus} |"
         )
 
     lines.append("")
     lines.append("## Run statistics (mean / stddev / 95% CI)")
     lines.append("")
-    lines.append("| variant | np | batch | n | mean_ms | stdev_ms | ci95_ms |")
-    lines.append("|---|---:|---:|---:|---:|---:|---:|")
-    for v, np_, b, n, mean, sd, ci in conn.execute(
-        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms FROM run_stats "
-        "ORDER BY variant, batch, np"
+    lines.append("| variant | np | batch | n | mean_ms | stdev_ms | ci95_ms | corpus |")
+    lines.append("|---|---:|---:|---:|---:|---:|---:|---|")
+    for v, np_, b, n, mean, sd, ci, corpus in conn.execute(
+        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms, corpus FROM run_stats "
+        "ORDER BY corpus, variant, batch, np"
     ):
         lines.append(
             f"| {v} | {np_} | {b if b is not None else '-'} | {n} | {mean:.3f} "
             f"| {f'{sd:.3f}' if sd is not None else '-'} "
-            f"| {f'{ci:.3f}' if ci is not None else '-'} |"
+            f"| {f'{ci:.3f}' if ci is not None else '-'} | {corpus} |"
         )
 
     lines.append("")

@@ -122,6 +122,28 @@ def flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
     return total
 
 
+def matmul_flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
+    """Matmul-only FLOPs (conv MACs x2) for one image through Blocks 1-2.
+
+    The conventional MFU numerator: only work the MXU executes. Pool
+    compares, LRN window sums, bias adds and ReLU are excluded —
+    ``flops_per_image`` keeps the all-in count for throughput accounting.
+    """
+    h, w = cfg.in_height, cfg.in_width
+    total = 0
+    c_in = cfg.in_channels
+    for _name, spec in cfg.layer_chain():
+        if isinstance(spec, ConvSpec):
+            h = conv_out_dim(h, spec.filter_size, spec.padding, spec.stride)
+            w = conv_out_dim(w, spec.filter_size, spec.padding, spec.stride)
+            total += 2 * h * w * spec.out_channels * spec.filter_size**2 * c_in
+            c_in = spec.out_channels
+        elif isinstance(spec, PoolSpec):
+            h = pool_out_dim(h, spec.window, spec.stride)
+            w = pool_out_dim(w, spec.window, spec.stride)
+    return total
+
+
 def forward_blocks12(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
     """Forward pass Conv1→ReLU→Pool1→Conv2→ReLU→Pool2→LRN2.
 

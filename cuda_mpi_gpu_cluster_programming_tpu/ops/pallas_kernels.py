@@ -7,17 +7,23 @@ NOT a translation: the CUDA kernels map one thread per output element —
 scalar code that would waste the MXU entirely. Here:
 
 - conv: for each (fy, fx) filter tap, a strided window of the image becomes
-  a (Ho*Wo, C) x (C, K) matmul on the MXU, accumulated in fp32 VMEM. The
+  a (BH*Wo, C) x (C, K) matmul on the MXU, accumulated in fp32 VMEM. The
   channel axes live on the 128-wide lanes. Bias add + optional ReLU are
   fused into the same kernel (the reference launches ReLU separately).
 - maxpool: window max via F^2 shifted strided slices, elementwise VPU max.
 - LRN: channel-window sum of squares via shifted adds, one pow + divide —
   both LRN alpha conventions supported (see ops.reference.lrn).
 
-Grid: one program per batch image; whole padded images sit in VMEM (the
-largest, padded conv1 input, is 231*231*3*4B ~ 640 KB << 16 MB VMEM).
+Conv grid: one program per (batch image, BH-row output block). Row tiling
+keeps the per-program accumulator and window slices small — the earlier
+whole-image-per-program layout blew the 16 MB scoped-VMEM limit at batch
+>= 128 on a real v5e (18.5 MB stack allocation). W is padded to a multiple
+of 16 so collapsing (BH, Wo, C) windows to 2-D matmul operands is a
+layout-legal reshape for fp32 (8-sublane) AND bf16 (16-sublane) — Mosaic
+rejects the unaligned collapse outright in bf16 ("unsupported shape cast").
 Accumulation order over filter taps is fixed (row-major fy, fx), giving
-deterministic numerics across runs.
+deterministic numerics across runs; fp32 inputs use HIGHEST (true-fp32)
+MXU precision, bf16 inputs the native bf16 MACs with fp32 accumulation.
 
 On non-TPU backends the kernels run in Pallas interpreter mode so the same
 code path is unit-testable on the CPU mesh.
@@ -55,15 +61,32 @@ def _vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
-def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, ho: int, wo: int, relu: bool):
+# Output rows per conv program. BH * Wo_pad is the matmul M dim: 8*64=512
+# for conv1, 8*32=256 for conv2 — comfortably MXU-sized without bloating
+# the per-program VMEM footprint.
+_ROW_BLOCK = 8
+# W padded up to this multiple so the (BH, Wo, C) -> (BH*Wo, C) collapse is
+# sublane-aligned for fp32 (8) and bf16 (16) alike.
+_W_ALIGN = 16
+
+
+def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, relu: bool):
     """Space-to-depth conv: x_ref (1, Hs, Ws, S*S*C), w_ref (fq, fq, S*S*C, K).
 
-    Every tap group is a unit-stride window slice feeding one MXU matmul
-    (Mosaic forbids strided vector slices, and skinny K-dim matmuls would
-    waste the systolic array — the S*S*C contraction axis fixes both).
+    Program (i, j) computes output rows [j*bh, (j+1)*bh) of image i. Every
+    tap group is a unit-stride window slice feeding one MXU matmul (Mosaic
+    forbids strided vector slices, and skinny K-dim matmuls would waste the
+    systolic array — the S*S*C contraction axis fixes both).
     """
     cs = x_ref.shape[-1]
     k = w_ref.shape[-1]
+    row0 = pl.program_id(1) * bh
+    # fp32 inputs: HIGHEST = true fp32 MACs on the MXU (the default would
+    # round the operands to bf16 and miss the reference numerics by ~1e-3
+    # rel). bf16 inputs: native bf16 MACs, fp32 accumulation.
+    prec = (
+        lax.Precision.HIGHEST if x_ref.dtype == jnp.float32 else lax.Precision.DEFAULT
+    )
 
     # fori_loop over the H tap (dim 1 is untiled, so a dynamic start is
     # always legal); the W taps are a static Python unroll — W is the
@@ -74,20 +97,18 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, ho: int, wo: int, relu:
     # deterministic fp32 accumulation (SURVEY §7.3).
     def tap_row(qh, acc):
         for qw in range(fq):
-            win = x_ref[0, pl.ds(qh, ho), qw : qw + wo, :]
+            win = x_ref[0, pl.ds(row0 + qh, bh), qw : qw + wo_p, :]
             wtap = w_ref[pl.ds(qh, 1), qw, :, :]
-            # HIGHEST: true fp32 MACs on the MXU; the default would round the
-            # operands to bf16 and miss the reference numerics by ~1e-3 rel.
             acc = acc + jnp.dot(
-                win.reshape(ho * wo, cs),
+                win.reshape(bh * wo_p, cs),
                 wtap.reshape(cs, k),
                 preferred_element_type=jnp.float32,
-                precision=lax.Precision.HIGHEST,
+                precision=prec,
             )
         return acc
 
-    acc = lax.fori_loop(0, fq, tap_row, jnp.zeros((ho * wo, k), jnp.float32))
-    out = acc.reshape(ho, wo, k) + b_ref[:].astype(jnp.float32)
+    acc = lax.fori_loop(0, fq, tap_row, jnp.zeros((bh * wo_p, k), jnp.float32))
+    out = acc.reshape(bh, wo_p, k) + b_ref[:].astype(jnp.float32)
     if relu:
         out = jnp.maximum(out, 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
@@ -154,24 +175,34 @@ def conv2d_pallas(
 
     if ph or pw:
         x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
-    hs, ws = ho + fq - 1, wo + fq - 1  # s2d rows/cols the kernel reads
+    # Round the output tile up to (row-block, sublane-aligned W); the extra
+    # rows/cols read zero padding and are cropped after the call. Cheap:
+    # <= _W_ALIGN-1 wasted columns, <= _ROW_BLOCK-1 wasted rows.
+    bh = min(_ROW_BLOCK, ho)
+    nbh = -(-ho // bh)
+    ho_p = nbh * bh
+    wo_p = -(-wo // _W_ALIGN) * _W_ALIGN
+    hs, ws = ho_p + fq - 1, wo_p + fq - 1  # s2d rows/cols the kernel reads
     xs = _space_to_depth(x, s, hs, ws)
     ws2d = _weights_to_depth(w, s, fq)
     cs = s * s * c
 
-    kernel = functools.partial(_conv_kernel, fq=fq, ho=ho, wo=wo, relu=relu)
-    return pl.pallas_call(
+    kernel = functools.partial(_conv_kernel, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
+    out = pl.pallas_call(
         kernel,
-        grid=(n,),
+        grid=(n, nbh),
         in_specs=[
-            _vmem_spec((1, hs, ws, cs), lambda i: (i, 0, 0, 0)),
+            _vmem_spec((1, hs, ws, cs), lambda i, j: (i, 0, 0, 0)),
             _vmem_spec(),
             _vmem_spec(),
         ],
-        out_specs=_vmem_spec((1, ho, wo, w.shape[-1]), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, ho, wo, w.shape[-1]), x.dtype),
+        out_specs=_vmem_spec((1, bh, wo_p, w.shape[-1]), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, ho_p, wo_p, w.shape[-1]), x.dtype),
         interpret=_interpret(),
     )(xs, ws2d, b)
+    if ho_p != ho or wo_p != wo:
+        out = out[:, :ho, :wo, :]
+    return out
 
 
 def conv2d_pallas_hvalid(x, w, b, *, stride: int, padding_w: int):
@@ -241,7 +272,10 @@ def _lrn_kernel(x_ref, o_ref, *, size: int, alpha: float, beta: float, k: float,
     """Cross-channel LRN; the channel-window sum of squares is a banded
     0/1-matrix matmul on the MXU — no lane-dimension slicing, and the band
     edges implement the reference's window truncation exactly."""
-    x = x_ref[0]  # (H, W, C)
+    # All math in fp32 regardless of the activation dtype: the band matmul
+    # must be dtype-homogeneous (Mosaic rejects a bf16 lhs against the f32
+    # band — "Bad lhs type"), and the scale/power path is precision-critical.
+    x = x_ref[0].astype(jnp.float32)  # (H, W, C)
     h, w, c = x.shape
     half = size // 2
     ci = lax.broadcasted_iota(jnp.int32, (c, c), 0)

@@ -61,8 +61,37 @@ def _local_names() -> set:
     return names
 
 
+def _resolve(name: str) -> set:
+    try:
+        return {ai[4][0] for ai in socket.getaddrinfo(name, None)}
+    except OSError:
+        return set()
+
+
+_OWN_ADDRS: Optional[set] = None  # process-invariant; getfqdn can block on DNS
+
+
+def _own_addrs() -> set:
+    global _OWN_ADDRS
+    if _OWN_ADDRS is None:
+        local = {"127.0.0.1", "::1"}
+        for n in (socket.gethostname(), socket.getfqdn()):
+            local |= _resolve(n)
+        _OWN_ADDRS = local
+    return _OWN_ADDRS
+
+
 def is_local(host: HostSpec) -> bool:
-    return host.host in _local_names()
+    """True when this inventory entry addresses THIS machine.
+
+    Beyond the literal localhost spellings, resolve the entry and compare
+    against our own addresses — an inventory written with this machine's IP
+    or FQDN must use the local transport, not ssh (which this sshd-less CI
+    image cannot serve)."""
+    if host.host in _local_names():
+        return True
+    addrs = _resolve(host.host)
+    return bool(addrs) and bool(addrs & _own_addrs())
 
 
 @dataclasses.dataclass
@@ -240,6 +269,28 @@ def deploy_and_collect(
                 p.kill()
             p.wait()
             rc, status = None, TIMEOUT
+            if not is_local(h):
+                # Killing the local ssh client does NOT kill the remote
+                # workload it launched; an orphan would keep holding the
+                # coordinator port and poison the next deploy. Best-effort
+                # remote teardown: match the interpreter invocation of THIS
+                # script, regex-escaped and anchored so '.'/'+' in a module
+                # path can't over-match. Residual risk: a concurrent deploy
+                # of the SAME script on the same host is also matched —
+                # acceptable for the single-operator inventories this
+                # targets, and narrower than leaking the orphan.
+                pat = f"-m {re.escape(script)}( |$)"
+                try:
+                    subprocess.run(
+                        ["ssh", "-o", "BatchMode=yes", h.ssh_target,
+                         f"pkill -f -- {shlex.quote(pat)}"],
+                        capture_output=True,
+                        timeout=15,
+                    )
+                    f.write(f"# TIMEOUT: issued remote pkill -f {pat}\n")
+                    f.flush()
+                except (subprocess.TimeoutExpired, OSError):
+                    pass
         f.close()
         text = log_path.read_text(errors="replace")
         verdict, time_ms = _parse_log(text)

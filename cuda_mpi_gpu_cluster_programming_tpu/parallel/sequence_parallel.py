@@ -75,11 +75,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m_new, num, den
 
-    # pvary: the zero/neg-inf initials are shard-invariant, but the loop
-    # carries shard-varying updates — fori_loop needs both sides typed alike.
-    m0 = lax.pvary(jnp.full((b, h, lb), NEG_INF, jnp.float32), axis_name)
-    num0 = lax.pvary(jnp.zeros((b, h, lb, d), jnp.float32), axis_name)
-    den0 = lax.pvary(jnp.zeros((b, h, lb), jnp.float32), axis_name)
+    # The zero/neg-inf initials are shard-invariant, but the loop carries
+    # shard-varying updates — fori_loop needs both sides typed alike.
+    # lax.pcast(..., to='varying') is the current spelling; pvary is the
+    # deprecated alias kept as a fallback for older JAX builds.
+    if hasattr(lax, "pcast"):
+        _to_varying = lambda a: lax.pcast(a, axis_name, to="varying")  # noqa: E731
+    else:  # pragma: no cover — pre-pcast JAX
+        _to_varying = lambda a: lax.pvary(a, axis_name)  # noqa: E731
+    m0 = _to_varying(jnp.full((b, h, lb), NEG_INF, jnp.float32))
+    num0 = _to_varying(jnp.zeros((b, h, lb, d), jnp.float32))
+    den0 = _to_varying(jnp.zeros((b, h, lb), jnp.float32))
     # lax.fori_loop keeps the compiled program size O(1) in ring size (a
     # Python loop would unroll n_shards copies of the body — fine at 8,
     # wasteful at pod scale). The causal mask already indexes by the traced

@@ -84,6 +84,12 @@ def main(argv=None) -> int:
 
     maybe_initialize_from_env()
 
+    # Persistent compile cache (the prebuilt-binaries analogue,
+    # build_local_binaries.sh:8-10) — before the first jit.
+    from .utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     from .configs import REGISTRY, build_forward
     from .models.alexnet import BLOCKS12
     from .models.init import (

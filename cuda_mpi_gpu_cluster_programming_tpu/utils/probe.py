@@ -1,0 +1,46 @@
+"""Bounded device-health probe — single source of probe truth.
+
+The tunneled TPU can wedge indefinitely: ``jax.devices()`` (or the first
+tiny matmul) blocks forever with ~0% CPU. Every consumer that must not
+inherit that hang (bench.py, capture_evidence, harness timeout triage)
+runs this probe in a bounded subprocess instead of touching the device
+in-process.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices()[0];"
+    "v = float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum());"
+    "print('PROBE_OK', d.platform, v)"
+)
+
+
+def probe(timeout_s: float = 45.0) -> tuple:
+    """Run the bounded probe. Returns (ok, platform_or_reason)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s (wedged tunnel?)"
+    ok_line = next(
+        (l for l in proc.stdout.splitlines() if l.startswith("PROBE_OK")), None
+    )
+    if proc.returncode != 0 or ok_line is None:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-1:] or ["no output"]
+        return False, f"probe failed (rc={proc.returncode}): {tail[0][:160]}"
+    return True, ok_line.split()[1]
+
+
+def device_responsive(timeout_s: float = 45.0) -> bool:
+    return probe(timeout_s)[0]

@@ -36,7 +36,7 @@ ROOT = Path(__file__).resolve().parent.parent
 REFERENCE = Path("/root/reference")
 
 sys.path.insert(0, str(ROOT))
-from bench import _PROBE_SRC as PROBE  # single source of probe truth  # noqa: E402
+from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe  # noqa: E402
 
 
 def run(name: str, cmd, timeout_s: float, statuses: dict) -> subprocess.CompletedProcess | None:
@@ -70,15 +70,13 @@ def main() -> int:
     py = sys.executable
 
     # 1. Bounded probe — refuse to start a multi-hour capture on a wedge.
-    probe = run("probe", [py, "-u", "-c", PROBE], args.probe_timeout, statuses)
-    ok_line = next(
-        (l for l in (probe.stdout.splitlines() if probe else []) if l.startswith("PROBE_OK")),
-        None,
-    )
-    if probe is None or probe.returncode != 0 or ok_line is None:
-        print("\nDevice unreachable (wedged tunnel?) — nothing captured.")
+    print("\n=== probe: bounded device probe")
+    ok, info = probe(args.probe_timeout)
+    statuses["probe"] = "OK" if ok else info
+    if not ok:
+        print(f"\nDevice unreachable ({info}) — nothing captured.")
         return 3
-    platform = ok_line.split()[1]
+    platform = info
     print(f"device platform: {platform}")
 
     # 2. Harness sweep on the real backend (VERDICT r1 task 3 matrix).
@@ -87,7 +85,8 @@ def main() -> int:
     run(
         "harness",
         [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.harness",
-         "--configs", "v1_jit,v3_pallas", "--shards", "1",
+         "--configs", "v1_jit,v3_pallas" + ("" if args.quick else ",v6_full_jit,v6_full_pallas"),
+         "--shards", "1",
          "--batches", batches, "--computes", computes,
          "--timeout", "600", "--repeats", "50"],
         7200,

@@ -52,9 +52,10 @@ def test_ingest_views_and_dedup(tmp_path):
     )
     assert best[("V1 Serial", 1)] == 100.0
     # run_stats: mean/stddev/ci over V1 Serial
-    v, np_, b, n, mean, sd, ci = conn.execute(
+    v, np_, b, n, mean, sd, ci, corpus = conn.execute(
         "SELECT * FROM run_stats WHERE variant='V1 Serial'"
     ).fetchone()
+    assert corpus == "local"
     assert n == 2 and abs(mean - 110.0) < 1e-9
     assert abs(sd - 14.142135623730951) < 1e-6
     # SHA1-incremental re-ingest: unchanged files are skipped, rows not duplicated
@@ -144,6 +145,39 @@ def test_reference_corpus_ingest_end_to_end(tmp_path):
     by = {(r[0], r[1]): r for r in rows}
     assert abs(by[("V2.2 ScatterHalo", 4)][4] - 3.23) < 0.01
     assert abs(by[("V2.2 ScatterHalo", 4)][5] - 0.81) < 0.005
+    conn.close()
+
+
+@pytest.mark.skipif(not REFERENCE.exists(), reason="reference corpus not mounted")
+def test_per_corpus_speedup_baseline(tmp_path):
+    """Reference rows are judged against the reference's OWN V1 baseline,
+    local (TPU) rows against theirs — no cross-corpus T1 conflation.
+
+    Regression for the round-2 verdict finding: the reference's V1 np=1 row
+    must show S(N)=1.00 even when this repo's (much faster) batch-1 rows
+    share the warehouse. Reference semantics: log_analysis.py:213-222.
+    """
+    logs = tmp_path / "logs"
+    # Ingest the reference corpus from its real path so src_csv marks it.
+    conn = analysis.connect(tmp_path / "w.sqlite")
+    analysis.cmd_ingest(conn, REFERENCE / "final_project" / "logs", None)
+    # A local session with a dramatically faster V1 np=1 batch-1 row.
+    session = harness.Session(log_root=logs, session_id="tpu1", machine_id="tpu-host")
+    for np_, ms in [(1, 1.7), (2, 1.0)]:
+        r = harness.CaseResult("V1 Serial", "v1_jit", np_, 1)
+        r.run_status = harness.OK
+        r.time_ms = ms
+        r.shape = "13x13x256"
+        r.first5 = "29.2932 25.9153"
+        session.log_row(r)
+    analysis.cmd_ingest(conn, logs, None)
+
+    rows = analysis.cmd_speedup(conn, "V1 Serial")
+    by = {(r[6], r[0], r[1]): r for r in rows}
+    # Reference V1 np=1 vs its own corpus: exactly 1.00, not 0.00x.
+    assert abs(by[("reference", "V1 Serial", 1)][4] - 1.0) < 1e-9
+    # Local V1 np=1 likewise 1.00 against the local corpus.
+    assert abs(by[("local", "V1 Serial", 1)][4] - 1.0) < 1e-9
     conn.close()
 
 

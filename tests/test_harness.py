@@ -56,15 +56,26 @@ def test_classify_axon_backend_error_is_env_warn():
     assert harness.classify(1, text) == harness.ENV_WARN
 
 
-def test_classify_wedged_tunnel_timeout_is_env_warn():
+def test_classify_wedged_tunnel_timeout_triage():
     # Observed round 1 (MULTICHIP_r01.json, rc=124): the axon banner prints,
     # then execution blocks forever until the timeout wrapper kills the run.
     banner = (
         "WARNING:jax._src.xla_bridge:905: Platform 'axon' is experimental "
         "and not all JAX functionality may be correctly supported!\n"
     )
-    assert harness.classify(124, banner) == harness.ENV_WARN
-    assert harness.classify_timeout(banner) == harness.ENV_WARN
+    # The banner ALONE is no longer a wedge signal (every run prints it —
+    # a pre-compile framework deadlock would be masked). Without a probe
+    # verdict the hang stays TIMEOUT.
+    assert harness.classify(124, banner) == harness.TIMEOUT
+    assert harness.classify_timeout(banner) == harness.TIMEOUT
+    # The probe's explicit diagnosis in the log is decisive.
+    assert (
+        harness.classify_timeout(banner + "probe timed out after 45s (wedged tunnel?)")
+        == harness.ENV_WARN
+    )
+    # An active probe verdict is decisive either way.
+    assert harness.classify_timeout(banner, lambda: False) == harness.ENV_WARN
+    assert harness.classify_timeout(banner, lambda: True) == harness.TIMEOUT
 
 
 def test_classify_timeout_with_progress_is_real_timeout():
@@ -75,6 +86,8 @@ def test_classify_timeout_with_progress_is_real_timeout():
         "Compile time: 2000.0 ms\n"
     )
     assert harness.classify_timeout(text) == harness.TIMEOUT
+    # progress beats even a dead-device probe verdict: the run was alive
+    assert harness.classify_timeout(text, lambda: False) == harness.TIMEOUT
     # and a bare kill with no wedge signature stays TIMEOUT too
     assert harness.classify(124, "some unrelated output") == harness.TIMEOUT
 
