@@ -66,9 +66,6 @@ def peak_tflops(device_kind: str) -> float:
     return 197.0  # unknown kind: assume the chip we actually develop on
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import (  # noqa: E402
-    PROBE_SRC as _PROBE_SRC,
-)
 
 
 def _error_json(msg: str, platform: str = "unknown") -> str:

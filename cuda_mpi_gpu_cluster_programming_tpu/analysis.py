@@ -25,12 +25,11 @@ from __future__ import annotations
 import argparse
 import csv
 import hashlib
-import math
 import sqlite3
 import statistics
 import sys
 from pathlib import Path
-from typing import Iterable, List, Optional
+from typing import List, Optional
 
 DEFAULT_DB = ".warehouse/cluster_logs.sqlite"
 

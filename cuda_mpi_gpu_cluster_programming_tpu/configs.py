@@ -33,7 +33,7 @@ from typing import Callable, Dict, Optional
 
 import jax
 
-from .models.alexnet import BLOCKS12, Blocks12Config, forward_blocks12
+from .models.alexnet import BLOCKS12, forward_blocks12
 
 
 @dataclasses.dataclass(frozen=True)

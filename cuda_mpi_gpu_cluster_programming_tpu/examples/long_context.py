@@ -16,7 +16,6 @@ the same way the reference's speedup tables make its comm story visible.
 from __future__ import annotations
 
 import argparse
-import sys
 
 import jax
 import jax.numpy as jnp

@@ -32,8 +32,6 @@ code path is unit-testable on the CPU mesh.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -50,6 +48,15 @@ except Exception:  # pragma: no cover
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _tc_params(*semantics: str):
+    """Grid dimension semantics for the Mosaic scheduler ('parallel' grid
+    dims let it pipeline DMA against compute across programs). None in
+    interpreter mode, where CompilerParams is ignored anyway."""
+    if pltpu is None or _interpret():
+        return None
+    return pltpu.CompilerParams(dimension_semantics=tuple(semantics))
 
 
 def _vmem_spec(block_shape=None, index_map=None):
@@ -198,6 +205,7 @@ def conv2d_pallas(
         ],
         out_specs=_vmem_spec((1, bh, wo_p, w.shape[-1]), lambda i, j: (i, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, ho_p, wo_p, w.shape[-1]), x.dtype),
+        compiler_params=_tc_params("parallel", "parallel"),
         interpret=_interpret(),
     )(xs, ws2d, b)
     if ho_p != ho or wo_p != wo:
@@ -264,6 +272,7 @@ def maxpool_pallas(x: jax.Array, *, window: int, stride: int) -> jax.Array:
         in_specs=[_vmem_spec((s * s, 1, hp, wp, c), lambda i: (0, i, 0, 0, 0))],
         out_specs=_vmem_spec((1, ho, wo, c), lambda i: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
+        compiler_params=_tc_params("parallel"),
         interpret=_interpret(),
     )(xph)
 
@@ -310,6 +319,7 @@ def lrn_pallas(
         in_specs=[_vmem_spec((1, h, wdt, c), lambda i: (i, 0, 0, 0))],
         out_specs=_vmem_spec((1, h, wdt, c), lambda i: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=_tc_params("parallel"),
         interpret=_interpret(),
     )(x)
 

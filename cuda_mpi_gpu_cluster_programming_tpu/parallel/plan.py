@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 from ..models.alexnet import Blocks12Config, ConvSpec, LrnSpec, PoolSpec
 from ..ops.shapes import conv_out_dim, pool_out_dim

@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import NEG_INF
 from .mesh import make_mesh
@@ -82,7 +82,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
     if hasattr(lax, "pcast"):
         _to_varying = lambda a: lax.pcast(a, axis_name, to="varying")  # noqa: E731
     else:  # pragma: no cover — pre-pcast JAX
-        _to_varying = lambda a: lax.pvary(a, axis_name)  # noqa: E731
+        _to_varying = lambda a: lax.pvary(a, axis_name)  # noqa
     m0 = _to_varying(jnp.full((b, h, lb), NEG_INF, jnp.float32))
     num0 = _to_varying(jnp.zeros((b, h, lb, d), jnp.float32))
     den0 = _to_varying(jnp.zeros((b, h, lb), jnp.float32))

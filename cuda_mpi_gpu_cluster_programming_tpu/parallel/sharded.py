@@ -23,20 +23,19 @@ slice; Barrier/Wtime -> block_until_ready + host timing.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..models.alexnet import BLOCKS12, Blocks12Config
 from ..ops import reference as ops
 from .halo import exchange
 from .mesh import make_mesh
-from .plan import LayerPlan, ShardPlan, make_shard_plan
+from .plan import LayerPlan, make_shard_plan
 
 AXIS = "sp"
 

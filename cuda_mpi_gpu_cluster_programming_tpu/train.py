@@ -55,7 +55,6 @@ def main(argv=None) -> int:
     import dataclasses
 
     import jax
-    import numpy as np
     import optax
 
     from . import native

@@ -22,7 +22,7 @@ from typing import Callable, List, Tuple
 
 import jax
 
-from ..models.alexnet import BLOCKS12, Blocks12Config, ConvSpec, LrnSpec, Params, PoolSpec
+from ..models.alexnet import BLOCKS12, ConvSpec, LrnSpec, Params, PoolSpec
 from ..ops import reference as ops
 from .timing import amortized_ms
 
@@ -65,7 +65,9 @@ def stage_fns(
             elif isinstance(spec, PoolSpec):
                 stages.append((name, lambda p, x, s=spec: ops.maxpool(x, window=s.window, stride=s.stride)))
             elif isinstance(spec, LrnSpec):
-                stages.append((name, lambda p, x, s=spec: ops.lrn(x, size=s.size, alpha=s.alpha, beta=s.beta, k=s.k, alpha_over_size=s.alpha_over_size)))
+                stages.append((name, lambda p, x, s=spec: ops.lrn(
+                    x, size=s.size, alpha=s.alpha, beta=s.beta, k=s.k,
+                    alpha_over_size=s.alpha_over_size)))
         stages.append(("fc6", _fc_stage("fc6", relu_after=True)))
         stages.append(("fc7", _fc_stage("fc7", relu_after=True)))
         stages.append(("fc8", _fc_stage("fc8", relu_after=False)))
@@ -78,7 +80,9 @@ def stage_fns(
         ("conv2", lambda p, x: ops.conv2d(x, p["conv2"]["w"], p["conv2"]["b"], stride=c2.stride, padding=c2.padding)),
         ("relu2", lambda p, x: ops.relu(x)),
         ("pool2", lambda p, x: ops.maxpool(x, window=p2.window, stride=p2.stride)),
-        ("lrn2", lambda p, x: ops.lrn(x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size)),
+        ("lrn2", lambda p, x: ops.lrn(
+            x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k,
+            alpha_over_size=n2.alpha_over_size)),
     ]
 
 

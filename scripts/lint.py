@@ -1,0 +1,184 @@
+"""Static-analysis gate — the clang-tidy analogue (stdlib-only).
+
+The reference wires clang-tidy into its V4 build via bear/compile_commands
+(reference README.md:172,307; final_project/v4_mpi_cuda/.clang-tidy). This
+image ships no ruff/mypy/flake8 and installs are not allowed, so the gate
+is a self-contained AST checker enforcing the checks that have actually
+bitten this codebase plus the usual hygiene set:
+
+  syntax        — every file must compile (py_compile).
+  unused-import — imports never referenced (noqa-able).
+  bare-except   — ``except:`` swallows KeyboardInterrupt/SystemExit.
+  mutable-default — list/dict/set literals as parameter defaults.
+  deprecated    — banned API census (see DEPRECATED below), the tidy
+                  checks list; grown as CI surfaces new deprecations.
+  tabs / trailing-ws / long-lines(>120) — formatting conventions.
+
+Run: ``python scripts/lint.py [paths...]`` — exit 0 clean, 1 findings.
+A ``# noqa`` (optionally ``# noqa: <code>``) on the offending line
+suppresses a finding, same convention as ruff/flake8.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = ["cuda_mpi_gpu_cluster_programming_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py"]
+MAX_LINE = 120
+
+# Deprecated/banned API census (substring, reason). The tidy "checks" list.
+DEPRECATED = [
+    ("lax.pvary", "deprecated in JAX 0.9: use lax.pcast(x, axis, to='varying')"),  # noqa
+    (".tree_multimap", "removed from JAX: use jax.tree_util.tree_map"),  # noqa
+    ("jax.tree_map", "deprecated alias: use jax.tree_util.tree_map"),  # noqa
+    ("np.float_", "removed in NumPy 2.0"),  # noqa
+]
+
+Finding = Tuple[Path, int, str, str]  # file, line, code, message
+
+
+def _noqa_lines(src: str) -> dict:
+    """line -> set of suppressed codes ('*' = all)."""
+    out = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" in line:
+            _, _, rest = line.partition("# noqa")
+            codes = rest.lstrip(":").strip()
+            out[i] = {c.strip() for c in codes.split(",")} if codes.startswith(":") or codes else {"*"}
+            if rest.strip().startswith(":"):
+                out[i] = {c.strip() for c in rest.strip()[1:].split(",") if c.strip()}
+            else:
+                out[i] = {"*"}
+    return out
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: Path, src: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.imported: dict = {}  # name -> lineno
+        self.used: set = set()
+        self.src = src
+
+    # --- imports ---
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported[name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported[a.asname or a.name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+    # --- bare except ---
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.findings.append(
+                (self.path, node.lineno, "bare-except",
+                 "bare 'except:' also catches KeyboardInterrupt/SystemExit")
+            )
+        self.generic_visit(node)
+
+    # --- mutable defaults ---
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [d for d in node.args.kw_defaults if d]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(
+                    (self.path, d.lineno, "mutable-default",
+                     f"mutable default argument in {node.name}()")
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def finish(self) -> None:
+        # __init__.py re-exports and __future__ are legitimate "unused".
+        if self.path.name == "__init__.py":
+            return
+        for name, lineno in self.imported.items():
+            if name in self.used or name == "annotations":
+                continue
+            # Referenced only inside a docstring/string (e.g. doctest) still
+            # counts as unused; that is what # noqa is for.
+            self.findings.append(
+                (self.path, lineno, "unused-import", f"'{name}' imported but unused")
+            )
+
+
+def check_file(path: Path) -> List[Finding]:
+    src = path.read_text(errors="replace")
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "syntax", str(e.msg))]
+    checker = _Checker(path, src)
+    checker.visit(tree)
+    checker.finish()
+    findings.extend(checker.findings)
+
+    for i, line in enumerate(src.splitlines(), 1):
+        if "\t" in line:
+            findings.append((path, i, "tabs", "tab character"))
+        if line != line.rstrip():
+            findings.append((path, i, "trailing-ws", "trailing whitespace"))
+        if len(line) > MAX_LINE:
+            findings.append((path, i, "long-line", f"{len(line)} > {MAX_LINE} chars"))
+        for pat, why in DEPRECATED:
+            if pat in line and not line.lstrip().startswith("#"):
+                findings.append((path, i, "deprecated", f"{pat}: {why}"))
+
+    noqa = _noqa_lines(src)
+    return [
+        f for f in findings
+        if not (f[1] in noqa and ("*" in noqa[f[1]] or f[2] in noqa[f[1]]))
+    ]
+
+
+def main(argv=None) -> int:
+    paths = [Path(p) for p in (argv or sys.argv[1:]) or [ROOT / p for p in DEFAULT_PATHS]]
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    all_findings: List[Finding] = []
+    for f in files:
+        all_findings.extend(check_file(f))
+    for path, line, code, msg in all_findings:
+        try:
+            rel = path.relative_to(ROOT)
+        except ValueError:
+            rel = path
+        print(f"{rel}:{line}: [{code}] {msg}")
+    print(f"lint: {len(files)} files, {len(all_findings)} findings")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

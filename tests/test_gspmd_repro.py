@@ -26,7 +26,6 @@ Run the paired script on a real TPU to settle the backend question:
 import importlib.util
 import os
 
-import pytest
 
 
 def _load_repro():

@@ -11,7 +11,6 @@ import tarfile
 import pytest
 
 from cuda_mpi_gpu_cluster_programming_tpu.scaffold import (
-    FAILED,
     PASSED,
     SKIPPED,
     cmd_new,
