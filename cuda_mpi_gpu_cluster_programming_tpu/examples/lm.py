@@ -27,6 +27,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--shards", type=int, default=1, help="sp shards for ring/ulysses")
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--period", type=int, default=8, help="repeating-pattern period")
+    p.add_argument(
+        "--experts", type=int, default=0,
+        help="MoE experts per FFN (0 = dense); expert axis ep-shards over the "
+        "devices when the device count divides it, else runs replicated",
+    )
+    p.add_argument(
+        "--pp-stages", type=int, default=0,
+        help="pipeline stages for the decoder stack (0 = no pipeline)",
+    )
+    p.add_argument("--microbatches", type=int, default=2, help="pp microbatches")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1.0, help="PASS threshold")
     p.add_argument("--fake-devices", type=int, default=0)
@@ -70,25 +80,74 @@ def main(argv=None) -> int:
         print(err, file=sys.stderr)
         return 2
 
+    # PP argument guards — clean rc=2, same policy as the --shards checks.
+    if args.pp_stages:
+        if TINY_LM.n_layers % args.pp_stages:
+            err = f"--pp-stages must divide n_layers={TINY_LM.n_layers}, got {args.pp_stages}"
+        elif args.pp_stages > jax.device_count():
+            err = (
+                f"--pp-stages {args.pp_stages} exceeds {jax.device_count()} available "
+                f"device(s) (use --fake-devices N on CPU)"
+            )
+        elif args.microbatches < 1 or args.batch % args.microbatches:
+            err = (
+                f"--microbatches must divide --batch "
+                f"({args.batch} % {args.microbatches} != 0)"
+            )
+        if err is not None:
+            print(err, file=sys.stderr)
+            return 2
     cfg = dataclasses.replace(
         TINY_LM,
         attn_impl=args.attn,
         sp_shards=args.shards,
         max_len=max(TINY_LM.max_len, args.seq_len),
+        n_experts=args.experts,
     )
     params = init_transformer(jax.random.PRNGKey(args.seed), cfg)
+    # Expert parallelism: when the device count divides the expert count,
+    # shard the expert axis over an "ep" mesh (GSPMD inserts the
+    # all-to-alls). Otherwise the MoE runs replicated (single device).
+    ep_note = ""
+    if args.experts and not args.pp_stages:
+        n_dev = jax.device_count()
+        if n_dev > 1 and args.experts % n_dev == 0:
+            from ..parallel.expert import shard_moe_params
+            from ..parallel.mesh import make_mesh
+
+            params = shard_moe_params(params, make_mesh(n_dev, axis_name="ep"))
+            ep_note = f", ep-sharded over {n_dev} devices"
     # +1 token so the next-token shift keeps L divisible by the sp shards.
     base = jnp.arange(args.seq_len + 1, dtype=jnp.int32) % args.period
     tokens = jnp.tile(base[None], (args.batch, 1))
 
+    extras = (f", experts={cfg.n_experts}{ep_note}" if cfg.n_experts else "") + (
+        f", pp={args.pp_stages}x{args.microbatches}mb" if args.pp_stages else ""
+    )
     print(
         f"--- Byte-LM training [{args.attn}] (shards={args.shards}, "
         f"L={args.seq_len}, batch={args.batch}, layers={cfg.n_layers}, "
-        f"d={cfg.d_model}) ---"
+        f"d={cfg.d_model}{extras}) ---"
     )
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
 
-    opt_init, step = make_lm_train_step(cfg, lr=args.lr)
+    if args.pp_stages:
+        # Pipeline the decoder stack: same loss through the shared step
+        # factory, staged GPipe schedule inside the loss.
+        from ..parallel.mesh import make_mesh
+        from ..parallel.pipeline import pipeline_lm_loss
+
+        pp_mesh = make_mesh(args.pp_stages, axis_name="pp")
+        opt_init, step = make_lm_train_step(
+            cfg,
+            lr=args.lr,
+            loss_fn=lambda p, t: pipeline_lm_loss(
+                p, t, cfg, n_stages=args.pp_stages,
+                n_microbatches=args.microbatches, mesh=pp_mesh,
+            ),
+        )
+    else:
+        opt_init, step = make_lm_train_step(cfg, lr=args.lr)
     opt_state = opt_init(params)
     first = last = None
     t0 = time.perf_counter()

@@ -13,6 +13,13 @@ Design is TPU-first: pure-functional params pytree, static shapes, RMSNorm,
 learned positional embeddings (static slice — no data-dependent control
 flow), bf16-safe (norms and softmax statistics in fp32), weight-tied LM
 head so the embedding matmul rides the MXU twice.
+
+The FFN is pluggable too: dense (default) or a Switch-style top-1
+mixture-of-experts (``n_experts > 0``) whose capacity-limited dense
+dispatch/combine einsums are the EP tier — ``parallel.expert`` shards the
+expert axis over an "ep" mesh axis. The decoder block is exposed as
+``decoder_block`` so ``parallel.pipeline`` can stage the layer stack over
+a "pp" axis without duplicating any model code.
 """
 
 from __future__ import annotations
@@ -39,6 +46,10 @@ class TransformerConfig:
     max_len: int = 1024
     attn_impl: str = "reference"  # reference | flash | ring | ulysses
     sp_shards: int = 1  # ring/ulysses mesh size
+    # Mixture-of-experts FFN (0 = dense). Top-1 (Switch) routing with a
+    # capacity limit; the expert axis is what EP shards (see moe_ffn).
+    n_experts: int = 0
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -65,16 +76,22 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig = TINY_LM, dtype=jnp
     }
     resid_scale = 1.0 / math.sqrt(2 * cfg.n_layers)
     for _ in range(cfg.n_layers):
-        params["layers"].append(
-            {
-                "attn_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
-                "wqkv": dense(next(keys), cfg.d_model, (cfg.d_model, 3 * cfg.d_model)),
-                "wo": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_model), resid_scale),
-                "mlp_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
-                "w_up": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_ff)),
-                "w_down": dense(next(keys), cfg.d_ff, (cfg.d_ff, cfg.d_model), resid_scale),
-            }
-        )
+        layer = {
+            "attn_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
+            "wqkv": dense(next(keys), cfg.d_model, (cfg.d_model, 3 * cfg.d_model)),
+            "wo": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_model), resid_scale),
+            "mlp_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
+        }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            kr, ku, kd = jax.random.split(next(keys), 3)
+            layer["router"] = dense(kr, cfg.d_model, (cfg.d_model, e))
+            layer["w_up"] = dense(ku, cfg.d_model, (e, cfg.d_model, cfg.d_ff))
+            layer["w_down"] = dense(kd, cfg.d_ff, (e, cfg.d_ff, cfg.d_model), resid_scale)
+        else:
+            layer["w_up"] = dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_ff))
+            layer["w_down"] = dense(next(keys), cfg.d_ff, (cfg.d_ff, cfg.d_model), resid_scale)
+        params["layers"].append(layer)
     return params
 
 
@@ -103,6 +120,67 @@ def _attend(q, k, v, cfg: TransformerConfig, mesh=None):
     raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 
 
+def moe_ffn(layer: Params, h: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Top-1 (Switch) mixture-of-experts FFN with a capacity limit.
+
+    The EP tier: expert-stacked weights (E, D, F)/(E, F, D) carry the
+    expert axis that an "ep" mesh axis shards (see parallel/expert.py for
+    the sharding wrapper). Dispatch/combine are dense one-hot einsums —
+    static shapes, no gather/scatter — the GShard/Switch formulation GSPMD
+    partitions into all-to-alls on its own. Tokens routed past an expert's
+    capacity are dropped (contribute nothing; the residual connection
+    carries them unchanged) — standard Switch behavior, which also bounds
+    the damage of load imbalance; the aux load-balancing loss is a
+    training-quality refinement deliberately out of scope here.
+    """
+    b, l, d = h.shape
+    e = cfg.n_experts
+    t = b * l
+    cap = max(1, int(cfg.capacity_factor * t / e))
+    hf = h.reshape(t, d)
+    # Routing bookkeeping entirely in fp32/int32 — the module's bf16-safety
+    # rule: a bf16 cumsum is inexact past 256 tokens, which would corrupt
+    # queue positions (two tokens sharing a capacity slot get silently
+    # blended). Only the final dispatch/combine einsums run in h.dtype.
+    router_logits = (hf.astype(jnp.float32)) @ layer["router"].astype(jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)  # (T, E) fp32
+    idx = jnp.argmax(gates, axis=-1)  # (T,) top-1 expert
+    gate = jnp.take_along_axis(gates, idx[:, None], axis=-1)[:, 0]  # (T,) fp32
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, E)
+    # Position of each token in its expert's queue; beyond capacity -> drop.
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - onehot) * onehot, axis=-1).astype(jnp.int32)
+    keep = (pos < cap).astype(jnp.float32)
+    # dispatch (T, E, C): one-hot over (expert, slot), zero for dropped.
+    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # (T, C)
+    dispatch = (onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]).astype(h.dtype)
+    xin = jnp.einsum("tec,td->ecd", dispatch, hf)  # (E, C, D)
+    hidden = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, layer["w_up"]))
+    out_e = jnp.einsum("ecf,efd->ecd", hidden, layer["w_down"])  # (E, C, D)
+    combine = dispatch * gate[:, None, None].astype(h.dtype)
+    return jnp.einsum("tec,ecd->td", combine, out_e).reshape(b, l, d)
+
+
+def decoder_block(layer: Params, x: jax.Array, *, cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """One pre-norm decoder block: attention + (dense | MoE) FFN.
+
+    The shared unit of every execution shape: the plain stacked forward
+    (``forward_lm``), and the pipeline-parallel stage scan
+    (``parallel.pipeline``)."""
+    b, l, _ = x.shape
+    h = rmsnorm(x, layer["attn_norm"]["g"])
+    qkv = h @ layer["wqkv"]  # (B, L, 3*D)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, l, cfg.n_heads, cfg.head_dim)
+    out = _attend(q.reshape(shape), k.reshape(shape), v.reshape(shape), cfg, mesh)
+    x = x + out.reshape(b, l, cfg.d_model) @ layer["wo"]
+    h = rmsnorm(x, layer["mlp_norm"]["g"])
+    if cfg.n_experts:
+        x = x + moe_ffn(layer, h, cfg)
+    else:
+        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+    return x
+
+
 def forward_lm(
     params: Params,
     tokens: jax.Array,
@@ -110,19 +188,12 @@ def forward_lm(
     mesh=None,
 ) -> jax.Array:
     """tokens (B, L) int32 -> logits (B, L, vocab). Causal, weight-tied head."""
-    b, l = tokens.shape
+    l = tokens.shape[1]
     if l > cfg.max_len:
         raise ValueError(f"sequence length {l} exceeds max_len {cfg.max_len}")
     x = params["embed"][tokens] + params["pos"][:l][None]
     for layer in params["layers"]:
-        h = rmsnorm(x, layer["attn_norm"]["g"])
-        qkv = h @ layer["wqkv"]  # (B, L, 3*D)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, l, cfg.n_heads, cfg.head_dim)
-        out = _attend(q.reshape(shape), k.reshape(shape), v.reshape(shape), cfg, mesh)
-        x = x + out.reshape(b, l, cfg.d_model) @ layer["wo"]
-        h = rmsnorm(x, layer["mlp_norm"]["g"])
-        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+        x = decoder_block(layer, x, cfg=cfg, mesh=mesh)
     x = rmsnorm(x, params["final_norm"]["g"])
     return x @ params["embed"].T  # weight-tied LM head
 
@@ -141,20 +212,25 @@ def make_lm_train_step(
     mesh=None,
     optimizer=None,
     lr: float = 1e-3,
+    loss_fn=None,
 ):
     """(init_fn, step_fn) for LM training; any optax optimizer (default adam).
 
     With a mesh whose axes include "dp", the batch is expected sharded over
     it (GSPMD inserts the gradient all-reduce); ring/ulysses attention adds
-    the "sp" sequence axis inside the forward itself.
+    the "sp" sequence axis inside the forward itself. ``loss_fn(params,
+    tokens)`` overrides the default ``lm_loss`` — the single step factory
+    serves the plain, expert-parallel, and pipeline-parallel paths.
     """
     import optax
 
     opt = optimizer if optimizer is not None else optax.adam(lr)
+    if loss_fn is None:
+        loss_fn = lambda p, t: lm_loss(p, t, cfg, mesh)  # noqa: E731
 
     @jax.jit
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg, mesh)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, new_opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state, loss
 

@@ -95,26 +95,25 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, rel
         lax.Precision.HIGHEST if x_ref.dtype == jnp.float32 else lax.Precision.DEFAULT
     )
 
-    # fori_loop over the H tap (dim 1 is untiled, so a dynamic start is
-    # always legal); the W taps are a static Python unroll — W is the
-    # sublane-tiled dim, where Mosaic requires dynamic starts to be provably
-    # 8-aligned (fails for C>=128 lane-exact layouts, e.g. conv3's C=256).
-    # Only one fori body is live at a time, so at most fq windows coexist in
-    # VMEM (full fq^2 unrolling OOMed). Fixed (qh outer, qw inner) order =>
-    # deterministic fp32 accumulation (SURVEY §7.3).
-    def tap_row(qh, acc):
+    # Fully static fq x fq tap unroll: with 8-row windows (~100 KB each)
+    # the whole tap set fits VMEM comfortably (the pre-row-tiling kernel
+    # could only afford a fori_loop over qh — full unrolling of whole-image
+    # windows OOMed), and straight-line code lets Mosaic software-pipeline
+    # the matmul chain. Fixed (qh outer, qw inner) order => deterministic
+    # fp32 accumulation (SURVEY §7.3). The dynamic H start (row0 + qh) is
+    # legal because dim 1 is untiled; W taps must be static slices — W is
+    # the sublane-tiled dim, where Mosaic requires provable 8-alignment.
+    acc = jnp.zeros((bh * wo_p, k), jnp.float32)
+    for qh in range(fq):
         for qw in range(fq):
             win = x_ref[0, pl.ds(row0 + qh, bh), qw : qw + wo_p, :]
-            wtap = w_ref[pl.ds(qh, 1), qw, :, :]
+            wtap = w_ref[qh, qw, :, :]
             acc = acc + jnp.dot(
                 win.reshape(bh * wo_p, cs),
-                wtap.reshape(cs, k),
+                wtap,
                 preferred_element_type=jnp.float32,
                 precision=prec,
             )
-        return acc
-
-    acc = lax.fori_loop(0, fq, tap_row, jnp.zeros((bh * wo_p, k), jnp.float32))
     out = acc.reshape(bh, wo_p, k) + b_ref[:].astype(jnp.float32)
     if relu:
         out = jnp.maximum(out, 0.0)

@@ -1,0 +1,70 @@
+"""Expert parallelism: shard the MoE expert axis over an ``ep`` mesh axis.
+
+The GSPMD formulation (the scaling-book recipe): annotate the
+expert-stacked weights (E, D, F) / (E, F, D) and let XLA partition the
+dispatch/combine einsums of ``models.transformer.moe_ffn`` — the compiler
+inserts the all-to-alls that move token slots to their expert's device and
+back; no hand-written collectives. Composes with a "dp" axis on the batch
+(mesh ("dp", "ep")): gradients all-reduce over dp, expert FLOPs split
+over ep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+
+Params = Any
+
+# Expert-stacked param leaves (leading axis = expert) by key name.
+_EXPERT_KEYS = {"w_up", "w_down"}
+
+
+def shard_moe_params(
+    params: Params,
+    mesh: Optional[Mesh] = None,
+    *,
+    n_shards: int = 0,
+    axis_name: str = "ep",
+) -> Params:
+    """device_put the LM params with expert leaves sharded over ``ep``.
+
+    Every non-expert leaf is replicated. For a dense (n_experts=0) model
+    this degenerates to full replication. Expert count must divide the ep
+    axis size — the planner invariant, raised eagerly like plan.py's."""
+    if mesh is None:
+        mesh = make_mesh(n_shards, axis_name=axis_name)
+    ep = mesh.shape[axis_name]
+
+    def put(path, leaf):
+        is_expert = any(
+            getattr(k, "key", None) in _EXPERT_KEYS for k in path
+        ) and leaf.ndim >= 3
+        if is_expert:
+            if leaf.shape[0] % ep:
+                raise ValueError(
+                    f"{leaf.shape[0]} experts not divisible by {ep} '{axis_name}' shards"
+                )
+            spec = P(axis_name)
+        else:
+            spec = P()
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(put, params)
+
+
+def make_ep_train_step(cfg, mesh: Mesh, optimizer=None, lr: float = 1e-3):
+    """(init_fn, step_fn) with expert-sharded params.
+
+    ``step_fn(params, opt_state, tokens)`` — params as produced by
+    :func:`shard_moe_params`; jit + GSPMD keep the expert axis sharded
+    through forward, backward, and the optimizer update (optimizer state
+    inherits the param shardings). Delegates to the shared step factory —
+    EP needs no special step code, only the param placement."""
+    from ..models.transformer import make_lm_train_step
+
+    return make_lm_train_step(cfg, optimizer=optimizer, lr=lr)

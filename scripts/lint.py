@@ -47,8 +47,6 @@ def _noqa_lines(src: str) -> dict:
     for i, line in enumerate(src.splitlines(), 1):
         if "# noqa" in line:
             _, _, rest = line.partition("# noqa")
-            codes = rest.lstrip(":").strip()
-            out[i] = {c.strip() for c in codes.split(",")} if codes.startswith(":") or codes else {"*"}
             if rest.strip().startswith(":"):
                 out[i] = {c.strip() for c in rest.strip()[1:].split(",") if c.strip()}
             else:

@@ -1,0 +1,100 @@
+"""Pipeline-parallel tests: GPipe schedule over the "pp" mesh axis.
+
+The PP tier completes the parallelism zoo (dp/sp/tp/pp/ep). Correctness
+bar mirrors the sharded-tier contract: the pipelined forward must equal
+the sequential one (scheduling reorders nothing arithmetic)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
+    TransformerConfig,
+    forward_lm,
+    init_transformer,
+    lm_loss,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+from cuda_mpi_gpu_cluster_programming_tpu.parallel.pipeline import (
+    pipeline_lm_forward,
+    pipeline_lm_loss,
+    stack_layers,
+)
+
+CFG = TransformerConfig(d_model=32, n_heads=2, n_layers=4, d_ff=64, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    params = init_transformer(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, CFG.vocab)
+    return params, tokens
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 2), (4, 8), (1, 1)])
+def test_pipeline_forward_matches_sequential(lm, n_stages, n_micro):
+    params, tokens = lm
+    want = np.asarray(forward_lm(params, tokens, CFG))
+    got = np.asarray(
+        pipeline_lm_forward(
+            params, tokens, CFG, n_stages=n_stages, n_microbatches=n_micro
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_pipeline_loss_matches_sequential(lm):
+    params, tokens = lm
+    want = float(lm_loss(params, tokens, CFG))
+    got = float(
+        pipeline_lm_loss(params, tokens, CFG, n_stages=4, n_microbatches=2)
+    )
+    assert abs(got - want) < 1e-5, (got, want)
+
+
+def test_pipeline_is_differentiable_and_trains(lm):
+    params, tokens = lm
+    mesh = make_mesh(4, axis_name="pp")
+
+    def loss(p):
+        return pipeline_lm_loss(p, tokens, CFG, n_stages=4, n_microbatches=2, mesh=mesh)
+
+    l0, grads = jax.jit(jax.value_and_grad(loss))(params)
+    # Every stage's layer params received a nonzero gradient.
+    for i, layer in enumerate(grads["layers"]):
+        gnorm = sum(
+            float(jnp.abs(g).sum()) for g in jax.tree_util.tree_leaves(layer)
+        )
+        assert gnorm > 0, f"layer {i} got zero gradient through the pipeline"
+    # One SGD step reduces the loss.
+    stepped = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    l1 = float(loss(stepped))
+    assert l1 < float(l0), (l1, float(l0))
+
+
+def test_pipeline_gradients_match_sequential(lm):
+    params, tokens = lm
+    g_seq = jax.grad(lambda p: lm_loss(p, tokens, CFG))(params)
+    g_pp = jax.grad(
+        lambda p: pipeline_lm_loss(p, tokens, CFG, n_stages=2, n_microbatches=4)
+    )(params)
+    flat_seq = jax.tree_util.tree_leaves(g_seq)
+    flat_pp = jax.tree_util.tree_leaves(g_pp)
+    for a, b in zip(flat_seq, flat_pp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-5)
+
+
+def test_invariants(lm):
+    params, tokens = lm
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_lm_forward(params, tokens, CFG, n_stages=3, n_microbatches=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_lm_forward(params, tokens, CFG, n_stages=2, n_microbatches=3)
+
+
+def test_stack_layers_roundtrip(lm):
+    params, _ = lm
+    stacked = stack_layers(params["layers"])
+    leaf = jax.tree_util.tree_leaves(stacked)[0]
+    assert leaf.shape[0] == CFG.n_layers
