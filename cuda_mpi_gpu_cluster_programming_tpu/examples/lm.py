@@ -39,6 +39,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", type=int, default=2, help="pp microbatches")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1.0, help="PASS threshold")
+    p.add_argument("--save-params", help="save trained params to this .npz")
+    p.add_argument("--resume", help="load initial params from this .npz checkpoint")
     p.add_argument("--fake-devices", type=int, default=0)
     return p
 
@@ -104,7 +106,33 @@ def main(argv=None) -> int:
         max_len=max(TINY_LM.max_len, args.seq_len),
         n_experts=args.experts,
     )
-    params = init_transformer(jax.random.PRNGKey(args.seed), cfg)
+    if args.resume:
+        from ..utils.checkpoint import load_params_npz
+
+        like = init_transformer(jax.random.PRNGKey(args.seed), cfg)
+        params = load_params_npz(args.resume, like=like)
+        # Pre-flight shape check (clean rc=2 policy): a checkpoint saved
+        # under a different config (seq-len > saved max_len, different
+        # --experts, ...) must not surface as a jit broadcast traceback.
+        mismatches = [
+            f"{jax.tree_util.keystr(path)}: checkpoint {tuple(got.shape)} "
+            f"vs config {tuple(want.shape)}"
+            for (path, got), (_, want) in zip(
+                jax.tree_util.tree_leaves_with_path(params),
+                jax.tree_util.tree_leaves_with_path(like),
+            )
+            if tuple(got.shape) != tuple(want.shape)
+        ]
+        if mismatches:
+            print(
+                f"--resume {args.resume} does not match this run's config:\n  "
+                + "\n  ".join(mismatches[:8]),
+                file=sys.stderr,
+            )
+            return 2
+        print(f"Resumed params from {args.resume}")
+    else:
+        params = init_transformer(jax.random.PRNGKey(args.seed), cfg)
     # Expert parallelism: when the device count divides the expert count,
     # shard the expert axis over an "ep" mesh (GSPMD inserts the
     # all-to-alls). Otherwise the MoE runs replicated (single device).
@@ -161,6 +189,11 @@ def main(argv=None) -> int:
     wall = time.perf_counter() - t0
     tok_s = args.steps * args.batch * args.seq_len / wall
     print(f"Training completed in {wall * 1e3:.1f} ms ({tok_s:.0f} tok/s)")
+    if args.save_params:
+        from ..utils.checkpoint import save_params_npz
+
+        save_params_npz(args.save_params, params)
+        print(f"Saved params to {args.save_params}")
     ok = last <= args.target_loss
     print(
         f"Verification: loss {first:.4f} -> {last:.4f} "

@@ -63,6 +63,7 @@ def pipeline_apply(
     n_microbatches: int,
     mesh: Optional[Mesh] = None,
     axis_name: str = "pp",
+    dp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Run ``layer_fn`` over every layer of ``stacked_layers`` on ``x``,
     layers split into ``n_stages`` pipeline stages over the mesh.
@@ -71,13 +72,34 @@ def pipeline_apply(
     over their layers-per-stage block. ``x`` is (B, ...) with B divisible
     by ``n_microbatches``. Returns the same (B, ...) as the sequential
     ``for layer: x = layer_fn(layer, x)`` composition.
+
+    ``dp_axis`` composes the pipeline with data parallelism on a 2-D mesh
+    (e.g. ``Mesh(..., ("dp", "pp"))``): each dp row runs the full pipeline
+    on its microbatch slice — stage params replicated over dp, microbatch
+    dim sharded over dp, ppermute/psum confined to the pp axis. The caller
+    shards B over dp outside (or relies on shard_map's split here).
     """
     b = x.shape[0]
     m = n_microbatches
     if b % m:
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
     if mesh is None:
+        if dp_axis is not None:
+            raise ValueError(
+                "dp_axis requires an explicit 2-D mesh containing that axis "
+                "(the auto-built default mesh is pp-only)"
+            )
         mesh = make_mesh(n_stages, axis_name=axis_name)
+    if dp_axis is not None:
+        if dp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"dp_axis {dp_axis!r} not in mesh axes {mesh.axis_names}"
+            )
+        dp = mesh.shape[dp_axis]
+        if (b // m) % dp:
+            raise ValueError(
+                f"microbatch size {b // m} not divisible by dp axis {dp}"
+            )
     staged = _reshape_stages(stacked_layers, n_stages)
     x_mb = x.reshape(m, b // m, *x.shape[1:])
 
@@ -120,11 +142,19 @@ def pipeline_apply(
         y = lax.psum(emitted[s - 1 :], axis_name)
         return y
 
+    if dp_axis is None:
+        in_specs = (P(axis_name), P())  # stage axis sharded; input replicated
+        out_specs = P()
+    else:
+        # dp x pp: stage params replicated over dp; the microbatch dim (dim 1
+        # of x_mb) and of the output sharded over dp.
+        in_specs = (P(axis_name), P(None, dp_axis))
+        out_specs = P(None, dp_axis)
     fn = jax.shard_map(
         stage_body,
         mesh=mesh,
-        in_specs=(P(axis_name), P()),  # stage axis sharded; input replicated
-        out_specs=P(),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,  # psum-of-zeros trick produces a replicated result
     )
     y = fn(staged, x_mb)
@@ -139,6 +169,7 @@ def pipeline_lm_forward(
     n_stages: int,
     n_microbatches: int,
     mesh: Optional[Mesh] = None,
+    dp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Transformer-LM forward with the decoder stack pipelined over "pp".
 
@@ -161,6 +192,7 @@ def pipeline_lm_forward(
         n_stages=n_stages,
         n_microbatches=n_microbatches,
         mesh=mesh,
+        dp_axis=dp_axis,
     )
     x = rmsnorm(x, params["final_norm"]["g"])
     return x @ params["embed"].T
@@ -174,6 +206,7 @@ def pipeline_lm_loss(
     n_stages: int,
     n_microbatches: int,
     mesh: Optional[Mesh] = None,
+    dp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Next-token cross-entropy through the pipelined forward."""
     logits = pipeline_lm_forward(
@@ -183,6 +216,7 @@ def pipeline_lm_loss(
         n_stages=n_stages,
         n_microbatches=n_microbatches,
         mesh=mesh,
+        dp_axis=dp_axis,
     ).astype(jnp.float32)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)

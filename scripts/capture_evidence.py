@@ -65,6 +65,14 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true", help="small sweep for smoke runs")
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--skip-perf-sweep", action="store_true")
+    ap.add_argument(
+        "--sessions",
+        type=int,
+        default=1,
+        help="repeat the harness sweep N times (distinct session dirs) so the "
+        "warehouse run_stats CIs get n>=N samples per cell — the reference's "
+        "n=15-59 stats.csv cells need repeated sessions, not one big one",
+    )
     args = ap.parse_args()
     statuses: dict = {}
     py = sys.executable
@@ -79,19 +87,31 @@ def main() -> int:
     platform = info
     print(f"device platform: {platform}")
 
-    # 2. Harness sweep on the real backend (VERDICT r1 task 3 matrix).
+    # 2. Harness sweep on the real backend (VERDICT r1 task 3 matrix),
+    #    repeated --sessions times; each run_case subprocess stamps its own
+    #    session dir, so every repetition is an independent sample.
     batches = "1,32" if args.quick else "1,32,128,256"
     computes = "fp32" if args.quick else "fp32,bf16"
-    run(
-        "harness",
-        [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.harness",
-         "--configs", "v1_jit,v3_pallas" + ("" if args.quick else ",v6_full_jit,v6_full_pallas"),
-         "--shards", "1",
-         "--batches", batches, "--computes", computes,
-         "--timeout", "600", "--repeats", "50"],
-        7200,
-        statuses,
-    )
+    for i in range(max(1, args.sessions)):
+        tag = "harness" if args.sessions == 1 else f"harness[{i + 1}/{args.sessions}]"
+        run(
+            tag,
+            [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.harness",
+             "--configs", "v1_jit,v3_pallas" + ("" if args.quick else ",v6_full_jit,v6_full_pallas"),
+             "--shards", "1",
+             "--batches", batches, "--computes", computes,
+             "--timeout", "600", "--repeats", "50"],
+            7200,
+            statuses,
+        )
+    if args.sessions > 1:
+        # Essential-gate status = worst of ALL sessions: a failed repeat
+        # means run_stats has fewer samples than --sessions promised.
+        bad = [
+            v for k, v in statuses.items()
+            if k.startswith("harness[") and v != "OK"
+        ]
+        statuses["harness"] = bad[0] if bad else "OK"
 
     # 3. Headline bench (JSON line with MFU).
     bench = run("bench", [py, "bench.py"], 1200, statuses)
