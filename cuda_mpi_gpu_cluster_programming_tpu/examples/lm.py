@@ -110,7 +110,16 @@ def main(argv=None) -> int:
         from ..utils.checkpoint import load_params_npz
 
         like = init_transformer(jax.random.PRNGKey(args.seed), cfg)
-        params = load_params_npz(args.resume, like=like)
+        try:
+            params = load_params_npz(args.resume, like=like)
+        except KeyError as e:
+            # Structurally different config (dense checkpoint + --experts,
+            # etc.): the archive is missing leaves the like-tree expects.
+            print(
+                f"--resume {args.resume} does not match this run's config: {e}",
+                file=sys.stderr,
+            )
+            return 2
         # Pre-flight shape check (clean rc=2 policy): a checkpoint saved
         # under a different config (seq-len > saved max_len, different
         # --experts, ...) must not surface as a jit broadcast traceback.

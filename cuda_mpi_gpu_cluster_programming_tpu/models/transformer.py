@@ -78,7 +78,12 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig = TINY_LM, dtype=jnp
     for _ in range(cfg.n_layers):
         layer = {
             "attn_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
-            "wqkv": dense(next(keys), cfg.d_model, (cfg.d_model, 3 * cfg.d_model)),
+            # (D, 3, D) rather than packed (D, 3D): the explicit q/k/v axis
+            # keeps tensor-parallel column shards aligned with the split —
+            # a packed layout sharded in contiguous 3D/tp chunks crosses
+            # the q/k/v boundaries whenever tp is not a multiple of 3,
+            # forcing GSPMD to reshard inside attention.
+            "wqkv": dense(next(keys), cfg.d_model, (cfg.d_model, 3, cfg.d_model)),
             "wo": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.d_model), resid_scale),
             "mlp_norm": {"g": jnp.ones((cfg.d_model,), dtype)},
         }
@@ -168,8 +173,8 @@ def decoder_block(layer: Params, x: jax.Array, *, cfg: TransformerConfig, mesh=N
     (``parallel.pipeline``)."""
     b, l, _ = x.shape
     h = rmsnorm(x, layer["attn_norm"]["g"])
-    qkv = h @ layer["wqkv"]  # (B, L, 3*D)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    qkv = jnp.einsum("bld,dse->blse", h, layer["wqkv"])  # (B, L, 3, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     shape = (b, l, cfg.n_heads, cfg.head_dim)
     out = _attend(q.reshape(shape), k.reshape(shape), v.reshape(shape), cfg, mesh)
     x = x + out.reshape(b, l, cfg.d_model) @ layer["wo"]

@@ -122,3 +122,57 @@ def build_tp_forward(
         out_specs=P(None, None, None, axis_name),
     )
     return jax.jit(fn)
+
+
+# --- Megatron-style tensor parallelism for the transformer LM family ----
+#
+# The conv TP above hand-writes its collectives (channel-halo LRN needs
+# them); the LM's matmuls need none by hand — the classic Megatron layout
+# (column-parallel wqkv/w_up, row-parallel wo/w_down, heads implicitly
+# split with wqkv) is expressed as GSPMD shardings and XLA inserts the two
+# all-reduces per block on its own. Scaling-book recipe: pick the mesh,
+# annotate, let the compiler place collectives.
+
+_LM_TP_SPECS = {
+    "wqkv": ("tp_col",),    # (D, 3, D) -> shard the last (per-projection) dim
+    "w_up": ("tp_col",),    # (D, F)    -> shard output dim
+    "wo": ("tp_row",),      # (D, D)    -> shard input dim
+    "w_down": ("tp_row",),  # (F, D)    -> shard input dim
+}
+
+
+def shard_lm_params_tp(params, mesh=None, *, n_shards: int = 0, axis_name: str = "tp"):
+    """device_put transformer-LM params in the Megatron TP layout.
+
+    Column-parallel matrices shard their LAST dim, row-parallel their
+    FIRST; embeddings, position table, norms (and MoE expert stacks, whose
+    parallel axis is "ep", not "tp") stay replicated. Works on dense-FFN
+    configs; per-matrix divisibility is validated eagerly.
+    """
+    from jax.sharding import NamedSharding
+
+    from .mesh import make_mesh as _make_mesh
+
+    if mesh is None:
+        mesh = _make_mesh(n_shards, axis_name=axis_name)
+    tp = mesh.shape[axis_name]
+
+    def put(path, leaf):
+        key = getattr(path[-1], "key", None) if path else None
+        kind = _LM_TP_SPECS.get(key)
+        if kind is None or leaf.ndim not in (2, 3):
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        # Column-parallel shards the LAST dim (for the (D, 3, D) wqkv that
+        # is the per-projection output dim, so q/k/v boundaries stay
+        # aligned); row-parallel shards the first.
+        dim = leaf.ndim - 1 if kind[0] == "tp_col" else 0
+        if leaf.shape[dim] % tp:
+            raise ValueError(
+                f"{key} dim {dim} size {leaf.shape[dim]} not divisible by "
+                f"{tp} '{axis_name}' shards"
+            )
+        spec_axes = [None] * leaf.ndim
+        spec_axes[dim] = axis_name
+        return jax.device_put(leaf, NamedSharding(mesh, P(*spec_axes)))
+
+    return jax.tree_util.tree_map_with_path(put, params)

@@ -74,6 +74,7 @@ def main() -> int:
         "n=15-59 stats.csv cells need repeated sessions, not one big one",
     )
     args = ap.parse_args()
+    args.sessions = max(1, args.sessions)  # 0/negative: still one session
     statuses: dict = {}
     py = sys.executable
 
@@ -92,7 +93,7 @@ def main() -> int:
     #    session dir, so every repetition is an independent sample.
     batches = "1,32" if args.quick else "1,32,128,256"
     computes = "fp32" if args.quick else "fp32,bf16"
-    for i in range(max(1, args.sessions)):
+    for i in range(args.sessions):
         tag = "harness" if args.sessions == 1 else f"harness[{i + 1}/{args.sessions}]"
         run(
             tag,

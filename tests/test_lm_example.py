@@ -81,3 +81,16 @@ def test_lm_resume_config_mismatch_rc2(tmp_path, capsys):
     err = capsys.readouterr().err
     assert rc == 2
     assert "does not match this run's config" in err
+
+
+def test_lm_resume_structural_mismatch_rc2(tmp_path, capsys):
+    """Dense checkpoint resumed with --experts: missing leaves -> rc=2."""
+    ckpt = str(tmp_path / "dense.npz")
+    assert lm.main(["--steps", "1", "--seq-len", "64", "--batch", "2",
+                    "--save-params", ckpt, "--target-loss", "999"]) == 0
+    capsys.readouterr()
+    rc = lm.main(["--steps", "1", "--seq-len", "64", "--batch", "2",
+                  "--experts", "4", "--resume", ckpt])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "does not match this run's config" in err
