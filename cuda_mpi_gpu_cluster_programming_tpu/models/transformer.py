@@ -125,7 +125,9 @@ def _attend(q, k, v, cfg: TransformerConfig, mesh=None):
     raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 
 
-def moe_ffn(layer: Params, h: jax.Array, cfg: TransformerConfig) -> jax.Array:
+def moe_ffn(
+    layer: Params, h: jax.Array, cfg: TransformerConfig, return_aux: bool = False
+):
     """Top-1 (Switch) mixture-of-experts FFN with a capacity limit.
 
     The EP tier: expert-stacked weights (E, D, F)/(E, F, D) carry the
@@ -135,8 +137,13 @@ def moe_ffn(layer: Params, h: jax.Array, cfg: TransformerConfig) -> jax.Array:
     partitions into all-to-alls on its own. Tokens routed past an expert's
     capacity are dropped (contribute nothing; the residual connection
     carries them unchanged) — standard Switch behavior, which also bounds
-    the damage of load imbalance; the aux load-balancing loss is a
-    training-quality refinement deliberately out of scope here.
+    the damage of load imbalance.
+
+    ``return_aux`` additionally returns the Switch load-balancing loss
+    ``E * sum_e f_e * P_e`` (f_e = fraction of tokens routed to expert e,
+    P_e = mean router probability of e) — 1.0 at perfect balance, up to E
+    at full collapse; ``lm_loss(aux_coef=...)`` adds it to the objective
+    so the router cannot collapse onto one expert.
     """
     b, l, d = h.shape
     e = cfg.n_experts
@@ -162,15 +169,32 @@ def moe_ffn(layer: Params, h: jax.Array, cfg: TransformerConfig) -> jax.Array:
     hidden = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, layer["w_up"]))
     out_e = jnp.einsum("ecf,efd->ecd", hidden, layer["w_down"])  # (E, C, D)
     combine = dispatch * gate[:, None, None].astype(h.dtype)
-    return jnp.einsum("tec,ecd->td", combine, out_e).reshape(b, l, d)
+    out = jnp.einsum("tec,ecd->td", combine, out_e).reshape(b, l, d)
+    if not return_aux:
+        return out
+    # Switch aux loss (fp32): differentiable through P_e (gates); f_e uses
+    # the pre-capacity argmax assignment, per the Switch formulation.
+    f_e = jnp.mean(onehot, axis=0)  # (E,) fraction routed to each expert
+    p_e = jnp.mean(gates, axis=0)  # (E,) mean router probability
+    aux = e * jnp.sum(f_e * p_e)
+    return out, aux
 
 
-def decoder_block(layer: Params, x: jax.Array, *, cfg: TransformerConfig, mesh=None) -> jax.Array:
+def decoder_block(
+    layer: Params,
+    x: jax.Array,
+    *,
+    cfg: TransformerConfig,
+    mesh=None,
+    return_aux: bool = False,
+):
     """One pre-norm decoder block: attention + (dense | MoE) FFN.
 
     The shared unit of every execution shape: the plain stacked forward
     (``forward_lm``), and the pipeline-parallel stage scan
-    (``parallel.pipeline``)."""
+    (``parallel.pipeline``, which uses the single-output form — the MoE
+    aux term is a training refinement, not part of the staged schedule).
+    """
     b, l, _ = x.shape
     h = rmsnorm(x, layer["attn_norm"]["g"])
     qkv = jnp.einsum("bld,dse->blse", h, layer["wqkv"])  # (B, L, 3, D)
@@ -179,11 +203,13 @@ def decoder_block(layer: Params, x: jax.Array, *, cfg: TransformerConfig, mesh=N
     out = _attend(q.reshape(shape), k.reshape(shape), v.reshape(shape), cfg, mesh)
     x = x + out.reshape(b, l, cfg.d_model) @ layer["wo"]
     h = rmsnorm(x, layer["mlp_norm"]["g"])
+    aux = jnp.float32(0.0)
     if cfg.n_experts:
-        x = x + moe_ffn(layer, h, cfg)
+        ffn, aux = moe_ffn(layer, h, cfg, return_aux=True)
+        x = x + ffn
     else:
         x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
-    return x
+    return (x, aux) if return_aux else x
 
 
 def forward_lm(
@@ -191,25 +217,48 @@ def forward_lm(
     tokens: jax.Array,
     cfg: TransformerConfig = TINY_LM,
     mesh=None,
-) -> jax.Array:
-    """tokens (B, L) int32 -> logits (B, L, vocab). Causal, weight-tied head."""
+    return_aux: bool = False,
+):
+    """tokens (B, L) int32 -> logits (B, L, vocab). Causal, weight-tied head.
+
+    ``return_aux`` also returns the mean MoE load-balance loss over layers
+    (0.0 for dense configs)."""
     l = tokens.shape[1]
     if l > cfg.max_len:
         raise ValueError(f"sequence length {l} exceeds max_len {cfg.max_len}")
     x = params["embed"][tokens] + params["pos"][:l][None]
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x = decoder_block(layer, x, cfg=cfg, mesh=mesh)
+        x, aux = decoder_block(layer, x, cfg=cfg, mesh=mesh, return_aux=True)
+        aux_total = aux_total + aux
     x = rmsnorm(x, params["final_norm"]["g"])
-    return x @ params["embed"].T  # weight-tied LM head
+    logits = x @ params["embed"].T  # weight-tied LM head
+    if return_aux:
+        return logits, aux_total / max(1, cfg.n_layers)
+    return logits
 
 
-def lm_loss(params: Params, tokens: jax.Array, cfg: TransformerConfig = TINY_LM, mesh=None) -> jax.Array:
-    """Next-token cross-entropy (fp32), mean over (B, L-1)."""
-    logits = forward_lm(params, tokens[:, :-1], cfg, mesh).astype(jnp.float32)
+def lm_loss(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig = TINY_LM,
+    mesh=None,
+    aux_coef: float = 0.01,
+) -> jax.Array:
+    """Next-token cross-entropy (fp32), mean over (B, L-1).
+
+    For MoE configs, adds ``aux_coef`` x the Switch load-balance loss
+    (0.01, the Switch-Transformer default) so the router cannot collapse
+    onto one expert; dense configs are unaffected."""
+    logits, aux = forward_lm(params, tokens[:, :-1], cfg, mesh, return_aux=True)
+    logits = logits.astype(jnp.float32)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if cfg.n_experts:
+        loss = loss + aux_coef * aux
+    return loss
 
 
 def make_lm_train_step(

@@ -160,12 +160,18 @@ def shard_lm_params_tp(params, mesh=None, *, n_shards: int = 0, axis_name: str =
     def put(path, leaf):
         key = getattr(path[-1], "key", None) if path else None
         kind = _LM_TP_SPECS.get(key)
-        if kind is None or leaf.ndim not in (2, 3):
+        # ndim rules keep this strictly the DENSE Megatron layout: wqkv is
+        # the (D, 3, D) rank-3 exception (column shard on the last,
+        # per-projection dim so q/k/v boundaries stay aligned). MoE expert
+        # stacks share the w_up/w_down key names at rank 3 but belong to
+        # the "ep" axis (parallel/expert.py), so they fall through to
+        # replication here, as documented.
+        if key == "wqkv" and leaf.ndim == 3:
+            dim = 2
+        elif kind is not None and leaf.ndim == 2:
+            dim = 1 if kind[0] == "tp_col" else 0
+        else:
             return jax.device_put(leaf, NamedSharding(mesh, P()))
-        # Column-parallel shards the LAST dim (for the (D, 3, D) wqkv that
-        # is the per-projection output dim, so q/k/v boundaries stay
-        # aligned); row-parallel shards the first.
-        dim = leaf.ndim - 1 if kind[0] == "tp_col" else 0
         if leaf.shape[dim] % tp:
             raise ValueError(
                 f"{key} dim {dim} size {leaf.shape[dim]} not divisible by "

@@ -104,10 +104,16 @@ class TestLmMegatronTP:
         want = np.asarray(forward_lm(params, tokens, cfg))
         mesh = make_mesh(4, axis_name="tp")
         tp_params = shard_lm_params_tp(params, mesh)
+        from jax.sharding import PartitionSpec as P
+
         layer = tp_params["layers"][0]
-        # Column-parallel: wqkv/w_up shard dim 1; row-parallel: wo/w_down dim 0.
-        assert len(layer["wqkv"].sharding.device_set) == 4
-        assert len(layer["wo"].sharding.device_set) == 4
+        # Pin the exact layout: column-parallel wqkv shards its LAST
+        # (per-projection) dim and w_up its output dim; row-parallel
+        # wo/w_down shard their input (first) dim.
+        assert layer["wqkv"].sharding.spec == P(None, None, "tp"), layer["wqkv"].sharding
+        assert layer["w_up"].sharding.spec == P(None, "tp")
+        assert layer["wo"].sharding.spec == P("tp", None)
+        assert layer["w_down"].sharding.spec == P("tp", None)
         assert tp_params["embed"].sharding.is_fully_replicated
         got = np.asarray(jax.jit(lambda p, t: forward_lm(p, t, cfg))(tp_params, tokens))
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
@@ -151,3 +157,27 @@ class TestLmMegatronTP:
         params = init_transformer(jax.random.PRNGKey(0), cfg)
         with pytest.raises(ValueError, match="not divisible"):
             shard_lm_params_tp(params, make_mesh(4, axis_name="tp"))
+
+
+def test_lm_tp_leaves_moe_expert_stacks_replicated():
+    """MoE expert stacks share w_up/w_down key names at rank 3 but belong
+    to the ep axis — shard_lm_params_tp must replicate them, not shard."""
+    import jax
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.tensor_parallel import (
+        shard_lm_params_tp,
+    )
+
+    cfg = TransformerConfig(d_model=32, n_heads=2, n_layers=1, d_ff=64, n_experts=2)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    # n_experts=2 on a 4-way tp mesh: must not raise and must replicate.
+    tp_params = shard_lm_params_tp(params, make_mesh(4, axis_name="tp"))
+    layer = tp_params["layers"][0]
+    assert layer["w_up"].sharding.is_fully_replicated
+    assert layer["w_down"].sharding.is_fully_replicated
+    assert layer["router"].sharding.is_fully_replicated
