@@ -68,6 +68,44 @@ def _vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
+# Conv lowering variant (resolved OUTSIDE jit on every call, then passed
+# as a static argument so it participates in the jit cache key — flipping
+# the env var mid-process re-traces instead of silently hitting the old
+# executable):
+#   "taps"  (default) — fq^2 tap matmuls per row block, static unroll.
+#   "fused" — host-side im2col + ONE big matmul per row block (candidate
+#             from docs/PALLAS_PERF.md's backlog; A/B on real TPU via
+#             TPU_FRAMEWORK_CONV=fused).
+def _conv_variant() -> str:
+    import os
+
+    v = os.environ.get("TPU_FRAMEWORK_CONV", "").strip().lower()
+    if not v:
+        return "taps"  # unset or set-but-empty: the default
+    if v not in ("taps", "fused"):
+        raise ValueError(f"TPU_FRAMEWORK_CONV must be taps|fused, got {v!r}")
+    return v
+
+
+def _conv_fused_kernel(x_ref, w_ref, b_ref, o_ref, *, bh: int, wo_p: int, relu: bool):
+    """im2col variant: x_ref (1, bh, wo_p, fq^2*cs), w_ref (fq^2*cs, K)."""
+    kdim = x_ref.shape[-1]
+    k = w_ref.shape[-1]
+    prec = (
+        lax.Precision.HIGHEST if x_ref.dtype == jnp.float32 else lax.Precision.DEFAULT
+    )
+    acc = jnp.dot(
+        x_ref[0].reshape(bh * wo_p, kdim),
+        w_ref[:],
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+    out = acc.reshape(bh, wo_p, k) + b_ref[:].astype(jnp.float32)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
 # Output rows per conv program. BH * Wo_pad is the matmul M dim: 8*64=512
 # for conv1, 8*32=256 for conv2 — comfortably MXU-sized without bloating
 # the per-program VMEM footprint.
@@ -146,7 +184,6 @@ def _weights_to_depth(w: jax.Array, s: int, fq: int) -> jax.Array:
     return w.transpose(0, 2, 1, 3, 4, 5).reshape(fq, fq, s * s * c, k)
 
 
-@functools.partial(jax.jit, static_argnames=("stride", "padding", "padding_w", "relu"))
 def conv2d_pallas(
     x: jax.Array,
     w: jax.Array,
@@ -156,6 +193,28 @@ def conv2d_pallas(
     padding: int = 0,
     padding_w: int | None = None,
     relu: bool = False,
+) -> jax.Array:
+    """Direct conv (+bias, optional fused ReLU) — thin wrapper resolving the
+    lowering variant from the environment before entering jit."""
+    return _conv2d_pallas(
+        x, w, b, stride=stride, padding=padding, padding_w=padding_w,
+        relu=relu, variant=_conv_variant(),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("stride", "padding", "padding_w", "relu", "variant")
+)
+def _conv2d_pallas(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array,
+    *,
+    stride: int,
+    padding: int = 0,
+    padding_w: int | None = None,
+    relu: bool = False,
+    variant: str = "taps",
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU). x: (N,H,W,C), w: (F,F,C,K).
 
@@ -193,20 +252,47 @@ def conv2d_pallas(
     ws2d = _weights_to_depth(w, s, fq)
     cs = s * s * c
 
-    kernel = functools.partial(_conv_kernel, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
-    out = pl.pallas_call(
-        kernel,
-        grid=(n, nbh),
-        in_specs=[
+    if variant == "fused":
+        # im2col variant: XLA materializes the tap-concatenated input
+        # host-side (HBM cost ~fq^2 x input, still << compute at these
+        # sizes) and the kernel is ONE (bh*wo_p, fq^2*cs) x (fq^2*cs, K)
+        # MXU matmul per row block — 3-9x better array fill than the
+        # tap-loop on conv1. Accumulation: one reduction over the whole
+        # contraction (deterministic, but a DIFFERENT fixed order than the
+        # tap-loop variant — pick one variant per process; tests hold
+        # within-variant bitwise equality).
+        xcol = jnp.concatenate(
+            [
+                xs[:, qh : qh + ho_p, qw : qw + wo_p, :]
+                for qh in range(fq)
+                for qw in range(fq)
+            ],
+            axis=-1,
+        )  # (N, ho_p, wo_p, fq^2*cs)
+        operands = (xcol, ws2d.reshape(fq * fq * cs, w.shape[-1]), b)
+        kernel = functools.partial(_conv_fused_kernel, bh=bh, wo_p=wo_p, relu=relu)
+        in_specs = [
+            _vmem_spec((1, bh, wo_p, fq * fq * cs), lambda i, j: (i, j, 0, 0)),
+            _vmem_spec(),
+            _vmem_spec(),
+        ]
+    else:
+        operands = (xs, ws2d, b)
+        kernel = functools.partial(_conv_kernel, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
+        in_specs = [
             _vmem_spec((1, hs, ws, cs), lambda i, j: (i, 0, 0, 0)),
             _vmem_spec(),
             _vmem_spec(),
-        ],
+        ]
+    out = pl.pallas_call(
+        kernel,
+        grid=(n, nbh),
+        in_specs=in_specs,
         out_specs=_vmem_spec((1, bh, wo_p, w.shape[-1]), lambda i, j: (i, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, ho_p, wo_p, w.shape[-1]), x.dtype),
         compiler_params=_tc_params("parallel", "parallel"),
         interpret=_interpret(),
-    )(xs, ws2d, b)
+    )(*operands)
     if ho_p != ho or wo_p != wo:
         out = out[:, :ho, :wo, :]
     return out

@@ -138,3 +138,28 @@ def test_maxpool_pallas_even_window_leftover():
     want = ops.maxpool(x, window=2, stride=2)
     assert got.shape == want.shape == (1, 5, 5, 4)
     assert jnp.array_equal(got, want)
+
+
+def test_conv_fused_variant_matches_taps(monkeypatch):
+    """TPU_FRAMEWORK_CONV=fused (im2col single-matmul) agrees with the
+    default tap-loop variant to fp32 reduction-reorder tolerance. The
+    variant is a STATIC jit argument resolved per call, so flipping the
+    env var mid-process re-traces (no stale-cache A/B)."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 31, 31, 3))
+    w = jax.random.normal(jax.random.PRNGKey(1), (11, 11, 3, 16)) * 0.1
+    b = jnp.ones((16,)) * 0.1
+
+    monkeypatch.delenv("TPU_FRAMEWORK_CONV", raising=False)
+    taps = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "fused")
+    fused = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "")  # set-but-empty = default
+    empty = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+
+    assert taps.shape == fused.shape == (2, 6, 6, 16)
+    np.testing.assert_allclose(fused, taps, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(empty, taps)  # same variant, same bits
