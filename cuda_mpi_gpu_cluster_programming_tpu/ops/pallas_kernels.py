@@ -68,14 +68,19 @@ def _vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
-# Conv lowering variant (resolved OUTSIDE jit on every call, then passed
-# as a static argument so it participates in the jit cache key — flipping
-# the env var mid-process re-traces instead of silently hitting the old
-# executable):
+# Conv lowering variant (resolved at TRACE time — outside this module's
+# own jit, so it participates in _conv2d_pallas's cache key):
 #   "taps"  (default) — fq^2 tap matmuls per row block, static unroll.
 #   "fused" — host-side im2col + ONE big matmul per row block (candidate
 #             from docs/PALLAS_PERF.md's backlog; A/B on real TPU via
 #             TPU_FRAMEWORK_CONV=fused).
+# SCOPE OF THE ENV SWITCH: callers that wrap the model in their OWN jit
+# (configs.build_forward, the sharded tier) bake the variant into that
+# outer trace — flipping the env afterwards does not retrace them. Set
+# the variant before the first forward of a process; the supported A/B
+# workflow is one process per variant (the run.py commands in
+# docs/PALLAS_PERF.md), which tests/test_pallas.py exercises for direct
+# (un-jitted-caller) calls in-process.
 def _conv_variant() -> str:
     import os
 
@@ -87,23 +92,33 @@ def _conv_variant() -> str:
     return v
 
 
-def _conv_fused_kernel(x_ref, w_ref, b_ref, o_ref, *, bh: int, wo_p: int, relu: bool):
-    """im2col variant: x_ref (1, bh, wo_p, fq^2*cs), w_ref (fq^2*cs, K)."""
-    kdim = x_ref.shape[-1]
-    k = w_ref.shape[-1]
-    prec = (
-        lax.Precision.HIGHEST if x_ref.dtype == jnp.float32 else lax.Precision.DEFAULT
-    )
-    acc = jnp.dot(
-        x_ref[0].reshape(bh * wo_p, kdim),
-        w_ref[:],
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )
+def _mxu_precision(dtype):
+    """fp32 inputs: HIGHEST = true fp32 MACs on the MXU (the default would
+    round the operands to bf16 and miss the reference numerics by ~1e-3
+    rel). bf16 inputs: native bf16 MACs, fp32 accumulation."""
+    return lax.Precision.HIGHEST if dtype == jnp.float32 else lax.Precision.DEFAULT
+
+
+def _conv_epilogue(acc, b_ref, o_ref, *, bh: int, wo_p: int, k: int, relu: bool):
+    """Shared bias + optional-ReLU + cast tail of both conv variants —
+    one place, so the variants cannot diverge numerically in the epilogue."""
     out = acc.reshape(bh, wo_p, k) + b_ref[:].astype(jnp.float32)
     if relu:
         out = jnp.maximum(out, 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _conv_fused_kernel(x_ref, w_ref, b_ref, o_ref, *, bh: int, wo_p: int, relu: bool):
+    """im2col variant: x_ref (1, bh, wo_p, fq^2*cs), w_ref (fq^2*cs, K)."""
+    kdim = x_ref.shape[-1]
+    k = w_ref.shape[-1]
+    acc = jnp.dot(
+        x_ref[0].reshape(bh * wo_p, kdim),
+        w_ref[:],
+        preferred_element_type=jnp.float32,
+        precision=_mxu_precision(x_ref.dtype),
+    )
+    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
 
 
 # Output rows per conv program. BH * Wo_pad is the matmul M dim: 8*64=512
@@ -126,12 +141,7 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, rel
     cs = x_ref.shape[-1]
     k = w_ref.shape[-1]
     row0 = pl.program_id(1) * bh
-    # fp32 inputs: HIGHEST = true fp32 MACs on the MXU (the default would
-    # round the operands to bf16 and miss the reference numerics by ~1e-3
-    # rel). bf16 inputs: native bf16 MACs, fp32 accumulation.
-    prec = (
-        lax.Precision.HIGHEST if x_ref.dtype == jnp.float32 else lax.Precision.DEFAULT
-    )
+    prec = _mxu_precision(x_ref.dtype)
 
     # Fully static fq x fq tap unroll: with 8-row windows (~100 KB each)
     # the whole tap set fits VMEM comfortably (the pre-row-tiling kernel
@@ -152,10 +162,7 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, rel
                 preferred_element_type=jnp.float32,
                 precision=prec,
             )
-    out = acc.reshape(bh, wo_p, k) + b_ref[:].astype(jnp.float32)
-    if relu:
-        out = jnp.maximum(out, 0.0)
-    o_ref[0] = out.astype(o_ref.dtype)
+    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
 
 
 def _space_to_depth(x: jax.Array, s: int, hs: int, ws: int) -> jax.Array:

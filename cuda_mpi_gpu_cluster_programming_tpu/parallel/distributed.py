@@ -254,6 +254,12 @@ def _selftest_main() -> int:
         raise SystemExit("selftest requires JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES")
     mesh = make_multihost_mesh()
     n_dev = jax.device_count()
+    # Grouping invariant: the inner (ICI) axis must stay intra-process;
+    # only the outer (DCN) axis crosses hosts.
+    for row in mesh.devices:
+        owners = {d.process_index for d in row}
+        if len(owners) != 1:
+            raise SystemExit(f"ICI axis crosses processes: {owners}")
 
     @jax.jit
     def global_sum(x):

@@ -142,9 +142,11 @@ def test_maxpool_pallas_even_window_leftover():
 
 def test_conv_fused_variant_matches_taps(monkeypatch):
     """TPU_FRAMEWORK_CONV=fused (im2col single-matmul) agrees with the
-    default tap-loop variant to fp32 reduction-reorder tolerance. The
-    variant is a STATIC jit argument resolved per call, so flipping the
-    env var mid-process re-traces (no stale-cache A/B)."""
+    default tap-loop variant to fp32 reduction-reorder tolerance. For
+    DIRECT conv2d_pallas calls the variant is a static jit arg resolved
+    per call, so flipping the env re-traces; callers with their own outer
+    jit bake the variant at their trace time (the supported A/B is one
+    process per variant — see pallas_kernels/_conv_variant)."""
     import numpy as np
 
     from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
