@@ -55,18 +55,7 @@ def make_train_step(
     opt = optimizer if optimizer is not None else optax.sgd(lr)
 
     def _build_step(loss_fn, pre=None, post=None):
-        @jax.jit
-        def step(params, opt_state, x, y):
-            if pre is not None:
-                params, x = pre(params, x)
-            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-            updates, new_opt_state = opt.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            if post is not None:
-                new_params = post(new_params)
-            return new_params, new_opt_state, loss
-
-        return step
+        return _jit_step(opt, loss_fn, pre, post)
 
     if sp_shards and sp_shards >= 1:
         from .parallel.sharded import build_sharded_forward
@@ -99,23 +88,6 @@ def make_train_step(
 
         return opt.init, _build_step(tp_loss_fn)
 
-    def x_spec() -> P:
-        if mesh is None:
-            return P()
-        names = mesh.axis_names
-        # Batch (dp) sharding only. Spatial-parallel training goes through
-        # the explicitly-differentiable shard_map + ppermute halo path in
-        # parallel.sharded (the framework's explicit-collectives design, the
-        # reference's MPI-halo analogue) rather than a GSPMD "sp" annotation
-        # on the H axis. Round 1 additionally observed wrong conv *weight*
-        # gradients from the GSPMD partitioner with an H-axis annotation;
-        # round 2 could NOT reproduce that on cpu/jax==0.9.0 (minimal conv,
-        # full model, remat, dp x sp all give correct grads — see
-        # scripts/gspmd_conv_grad_repro.py and tests/test_gspmd_repro.py,
-        # which will fail loudly if the bug (re)appears). Behavior on the
-        # axon TPU backend is still unverified.
-        return P("dp" if "dp" in names else None)
-
     def base_fwd(params, x):
         return forward_blocks12(params, x, cfg)
 
@@ -126,17 +98,88 @@ def make_train_step(
     def loss_fn(params, x, y):
         return jnp.mean((base_fwd(params, x) - y) ** 2)
 
+    pre, post = _dp_pre_post(mesh)
+    return opt.init, _build_step(loss_fn, pre=pre, post=post)
+
+
+def _jit_step(opt, loss_fn, pre=None, post=None) -> Callable:
+    """The shared update scaffold: (optional pre-constraints) ->
+    value_and_grad -> opt.update -> apply_updates -> (optional post) —
+    ONE home for the step discipline every trainable uses."""
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        if pre is not None:
+            params, x = pre(params, x)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if post is not None:
+            new_params = post(new_params)
+        return new_params, new_opt_state, loss
+
+    return step
+
+
+def _dp_pre_post(mesh: Mesh | None):
+    """(pre, post) sharding-constraint pair for the replicated-params /
+    dp-sharded-batch discipline; (None, None) without a mesh.
+
+    Batch (dp) sharding only. Spatial-parallel training goes through the
+    explicitly-differentiable shard_map + ppermute halo path in
+    parallel.sharded (the framework's explicit-collectives design, the
+    reference's MPI-halo analogue) rather than a GSPMD "sp" annotation on
+    the H axis. Round 1 additionally observed wrong conv *weight*
+    gradients from the GSPMD partitioner with an H-axis annotation;
+    round 2 could NOT reproduce that on cpu/jax==0.9.0 (minimal conv,
+    full model, remat, dp x sp all give correct grads — see
+    scripts/gspmd_conv_grad_repro.py and tests/test_gspmd_repro.py, which
+    will fail loudly if the bug (re)appears). Behavior on the axon TPU
+    backend is still unverified.
+    """
+    if mesh is None:
+        return None, None
+    spec = P("dp" if "dp" in mesh.axis_names else None)
+
     def pre(params, x):
-        if mesh is None:
-            return params, x
         return (
             jax.lax.with_sharding_constraint(params, NamedSharding(mesh, P())),
-            jax.lax.with_sharding_constraint(x, NamedSharding(mesh, x_spec())),
+            jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec)),
         )
 
     def post(new_params):
-        if mesh is None:
-            return new_params
         return jax.lax.with_sharding_constraint(new_params, NamedSharding(mesh, P()))
 
-    return opt.init, _build_step(loss_fn, pre=pre, post=post)
+    return pre, post
+
+
+def make_classifier_train_step(
+    cfg,
+    mesh: Mesh | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+    lr: float = 1e-3,
+    remat: bool = False,
+) -> Tuple[Callable, Callable]:
+    """(init_fn, step_fn) for FULL-AlexNet classification training.
+
+    The reference's extension task (conv3-5 + FC6-8, summary.md:29-45) made
+    trainable: cross-entropy over the FC8 logits,
+    ``step_fn(params, opt_state, x, labels)``. With a mesh containing "dp",
+    the batch is sharded over it and params stay replicated (GSPMD emits
+    the gradient all-reduce), same discipline as make_train_step.
+    """
+    from .models.alexnet_full import forward_alexnet
+
+    opt = optimizer if optimizer is not None else optax.adam(lr)
+
+    fwd = forward_alexnet
+    if remat:
+        fwd = jax.checkpoint(fwd, static_argnums=(2,))
+
+    def loss_fn(params, x, labels):
+        logits = fwd(params, x, cfg).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+    pre, post = _dp_pre_post(mesh)
+    return opt.init, _jit_step(opt, loss_fn, pre, post)

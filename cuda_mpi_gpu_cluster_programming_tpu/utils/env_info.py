@@ -29,6 +29,7 @@ PACKAGES = (
     "einops",
     "numpy",
     "pytest",
+    "hypothesis",
 )
 
 
