@@ -10,7 +10,13 @@ scalar code that would waste the MXU entirely. Here:
   a (BH*Wo, C) x (C, K) matmul on the MXU, accumulated in fp32 VMEM. The
   channel axes live on the 128-wide lanes. Bias add + optional ReLU are
   fused into the same kernel (the reference launches ReLU separately).
-- maxpool: window max via F^2 shifted strided slices, elementwise VPU max.
+- maxpool: separable two-stage max (rows then cols). The stride-s phase
+  split is a PURE VIEW reshape (H -> (H/s, s) preserves contiguity), so
+  no strided gather is ever materialized; the W stage reuses the same
+  kernel after an XLA transpose. Measured on v5e (scripts/pool_ab.py,
+  b=128 fp32): 3.7x faster than the phase-stack kernel on lane-aligned
+  channels (pool2: 0.39 vs 1.44 ms), within noise on pool1's C=96.
+  TPU_FRAMEWORK_POOL=phases restores the old single-kernel lowering.
 - LRN: channel-window sum of squares via shifted adds, one pow + divide —
   both LRN alpha conventions supported (see ops.reference.lrn).
 
@@ -68,28 +74,35 @@ def _vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
-# Conv lowering variant (resolved at TRACE time — outside this module's
-# own jit, so it participates in _conv2d_pallas's cache key):
-#   "taps"  (default) — fq^2 tap matmuls per row block, static unroll.
-#   "fused" — host-side im2col + ONE big matmul per row block (candidate
-#             from docs/PALLAS_PERF.md's backlog; A/B on real TPU via
-#             TPU_FRAMEWORK_CONV=fused).
-# SCOPE OF THE ENV SWITCH: callers that wrap the model in their OWN jit
-# (configs.build_forward, the sharded tier) bake the variant into that
-# outer trace — flipping the env afterwards does not retrace them. Set
-# the variant before the first forward of a process; the supported A/B
-# workflow is one process per variant (the run.py commands in
-# docs/PALLAS_PERF.md), which tests/test_pallas.py exercises for direct
-# (un-jitted-caller) calls in-process.
-def _conv_variant() -> str:
+def env_variant(env_name: str, default: str, allowed: tuple) -> str:
+    """Resolve a lowering-variant switch from the environment (shared by
+    TPU_FRAMEWORK_CONV / _POOL here and _CHAIN in pallas_model).
+
+    Resolved at TRACE time — outside the per-op jit, so the variant
+    participates in the jit cache key. SCOPE CAVEAT: callers that wrap
+    the model in their OWN jit (configs.build_forward, the sharded tier)
+    bake the variant into that outer trace — flipping the env afterwards
+    does not retrace them. Set the variant before the first forward of a
+    process; the supported A/B workflow is one process per variant (the
+    run.py commands in docs/PALLAS_PERF.md), which tests/test_pallas.py
+    exercises for direct (un-jitted-caller) calls in-process."""
     import os
 
-    v = os.environ.get("TPU_FRAMEWORK_CONV", "").strip().lower()
+    v = os.environ.get(env_name, "").strip().lower()
     if not v:
-        return "taps"  # unset or set-but-empty: the default
-    if v not in ("taps", "fused"):
-        raise ValueError(f"TPU_FRAMEWORK_CONV must be taps|fused, got {v!r}")
+        return default  # unset or set-but-empty: the default
+    if v not in allowed:
+        raise ValueError(f"{env_name} must be {'|'.join(allowed)}, got {v!r}")
     return v
+
+
+# Conv lowering variants:
+#   "taps"  (default) — fq^2 tap matmuls per row block, static unroll.
+#   "fused" — host-side im2col + ONE big matmul per row block. Measured
+#             ~2x SLOWER on v5e (docs/PALLAS_PERF.md round-3 results);
+#             kept as the recorded negative result.
+def _conv_variant() -> str:
+    return env_variant("TPU_FRAMEWORK_CONV", "taps", ("taps", "fused"))
 
 
 def _mxu_precision(dtype):
@@ -348,8 +361,21 @@ def _pool_phases(x: jax.Array, s: int, hp: int, wp: int) -> jax.Array:
     return jnp.stack(phases)
 
 
-@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def _pool_variant() -> str:
+    # sep2 is the measured default (scripts/pool_ab.py).
+    return env_variant("TPU_FRAMEWORK_POOL", "sep2", ("sep2", "phases"))
+
+
 def maxpool_pallas(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+    """Window max — thin wrapper resolving the lowering variant from the
+    environment before entering jit (same scope caveat as _conv_variant)."""
+    if _pool_variant() == "phases":
+        return _maxpool_phases(x, window=window, stride=stride)
+    return _maxpool_sep2(x, window=window, stride=stride)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def _maxpool_phases(x: jax.Array, *, window: int, stride: int) -> jax.Array:
     n, h, wdt, c = x.shape
     s = stride
     ho = (h - window) // s + 1
@@ -367,6 +393,56 @@ def maxpool_pallas(x: jax.Array, *, window: int, stride: int) -> jax.Array:
         compiler_params=_tc_params("parallel"),
         interpret=_interpret(),
     )(xph)
+
+
+def _axis_pool_kernel(x_ref, o_ref, *, window: int, stride: int, ho: int):
+    """Pool along H only. x_ref: (1, hq, s, W, C) — dims 1-2 are the
+    view-split H (half-row, phase), both untiled; W and C carry the 8x128
+    tiling unchanged. Output row i = max over taps fy of input row
+    i*s + fy = view element (i + fy//s, fy%s). Max is associative and
+    exact in floating point, so the two-stage split cannot change results.
+    """
+    out = None
+    for fy in range(window):
+        q, p = fy // stride, fy % stride
+        win = x_ref[0, q : q + ho, p]
+        out = win if out is None else jnp.maximum(out, win)
+    o_ref[0] = out
+
+
+def _pool_rows(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+    """Max-pool the H axis via the view-reshape phase split. x: (N,H,W,C).
+
+    The reshape H -> (hq, s) is contiguity-preserving — XLA emits no data
+    movement — which is the whole advantage over the phase-stack path
+    (whose s*s strided gathers cost more than the pool itself)."""
+    n, h, w, c = x.shape
+    s = stride
+    ho = (h - window) // s + 1
+    qmax = (window - 1) // s
+    hq = ho + qmax  # H view-rows the kernel reads
+    if h < hq * s:
+        x = jnp.pad(x, ((0, 0), (0, hq * s - h), (0, 0), (0, 0)))
+    xv = x[:, : hq * s].reshape(n, hq, s, w, c)
+    kernel = functools.partial(_axis_pool_kernel, window=window, stride=s, ho=ho)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[_vmem_spec((1, hq, s, w, c), lambda i: (i, 0, 0, 0, 0))],
+        out_specs=_vmem_spec((1, ho, w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, ho, w, c), x.dtype),
+        compiler_params=_tc_params("parallel"),
+        interpret=_interpret(),
+    )(xv)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def _maxpool_sep2(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+    """Separable two-stage pool: rows, transpose, rows again, transpose."""
+    y = _pool_rows(x, window=window, stride=stride)      # (N, ho, W, C)
+    yt = jnp.swapaxes(y, 1, 2)                           # (N, W, ho, C)
+    z = _pool_rows(yt, window=window, stride=stride)     # (N, wo, ho, C)
+    return jnp.swapaxes(z, 1, 2)                         # (N, ho, wo, C)
 
 
 def _lrn_kernel(x_ref, o_ref, *, size: int, alpha: float, beta: float, k: float, alpha_over_size: bool):

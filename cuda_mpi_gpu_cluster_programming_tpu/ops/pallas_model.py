@@ -9,21 +9,53 @@ per-call cudaMalloc/weight-reupload bottleneck (PROBLEMS.txt:114-135).
 
 from __future__ import annotations
 
+
 import jax
+import jax.numpy as jnp
 
 from ..models.alexnet import BLOCKS12, Blocks12Config
 from . import pallas_kernels as pk
 
 
+def _chain_variant() -> str:
+    """TPU_FRAMEWORK_CHAIN=pad128 runs block 1 with the channel axis
+    zero-padded 96 -> 128 end to end: conv1 gains full MXU column fill
+    (its N dim is the lane axis), pool1 runs on lane-aligned tiles (the
+    measured 3.7x regime of the sep2 pool, scripts/pool_ab.py), and conv2
+    contracts over 128 channels whose extra 32 are zeros. Padded lanes
+    carry exact zeros through conv1 (zero weights, zero bias, relu(0)=0)
+    and contribute exact +0.0 terms to conv2's accumulation — bitwise
+    identical to the plain chain on TPU (fixed Mosaic accumulation
+    order; verified on v5e), within 1 ulp on the CPU backend whose
+    matmul retiles the larger contraction (tests/test_pallas.py).
+    Measured on v5e b=128: no wall-clock delta vs plain (fp32 15.0 vs
+    15.1 ms, bf16 3.886 vs 3.884) — conv fp32 sits at the
+    precision-ceiling, not the fill limit, so the extra columns don't
+    pay. Kept as a layout experiment. Same scope caveat as
+    pallas_kernels.env_variant: resolved at trace time."""
+    return pk.env_variant("TPU_FRAMEWORK_CHAIN", "plain", ("plain", "pad128"))
+
+
+def _pad_axis(a: jax.Array, axis: int, to: int) -> jax.Array:
+    if a.shape[axis] >= to:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, to - a.shape[axis])
+    return jnp.pad(a, widths)
+
+
 def forward_blocks12_pallas(params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
     c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
-    x = pk.conv2d_pallas(
-        x, params["conv1"]["w"], params["conv1"]["b"], stride=c1.stride, padding=c1.padding, relu=True
-    )
+    pad128 = _chain_variant() == "pad128"
+    w1, b1 = params["conv1"]["w"], params["conv1"]["b"]
+    w2, b2 = params["conv2"]["w"], params["conv2"]["b"]
+    if pad128:
+        kp = -(-w1.shape[-1] // 128) * 128  # conv1 output channels -> 128
+        w1, b1 = _pad_axis(w1, 3, kp), _pad_axis(b1, 0, kp)
+        w2 = _pad_axis(w2, 2, kp)  # conv2 contraction axis: zero rows
+    x = pk.conv2d_pallas(x, w1, b1, stride=c1.stride, padding=c1.padding, relu=True)
     x = pk.maxpool_pallas(x, window=p1.window, stride=p1.stride)
-    x = pk.conv2d_pallas(
-        x, params["conv2"]["w"], params["conv2"]["b"], stride=c2.stride, padding=c2.padding, relu=True
-    )
+    x = pk.conv2d_pallas(x, w2, b2, stride=c2.stride, padding=c2.padding, relu=True)
     x = pk.maxpool_pallas(x, window=p2.window, stride=p2.stride)
     x = pk.lrn_pallas(
         x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size

@@ -1,0 +1,162 @@
+"""On-chip A/B of maxpool lowering strategies (pool1 is v3_pallas's hot spot).
+
+The round-3 per-layer breakdown on the real v5e showed pool1 costing 5.1 ms
+at batch 128 — 4x conv1 — making the pool, not the conv, the Pallas tier's
+bottleneck. Candidates measured here:
+
+  current   ops.pallas_kernels.maxpool_pallas (host stride-phase stack ->
+            phase-indexed kernel taps)
+  xla       jax.lax.reduce_window under jit — the compiler oracle
+  phases    ONLY the host-side _pool_phases repack (isolates how much of
+            `current` is the strided gather vs the kernel)
+  s2d128    space-to-depth repack (reshape+transpose, no strided gather)
+            with C zero-padded to a 128-lane multiple so every in-kernel
+            phase slice is a static, lane-aligned slice of the last dim
+  sep2      separable two-stage pool (row-max then col-max): the stride-2
+            phase split becomes a PURE VIEW reshape (H -> (H/2, 2) keeps
+            contiguity; no gather, no C padding); stage B transposes H<->W
+            host-side so the same view trick applies to the W axis
+
+Usage: python scripts/pool_ab.py [--batch 128] [--dtype fp32]
+Prints one JSON line per strategy; exits nonzero if any strategy's output
+mismatches the XLA oracle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_kernels as pk
+from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_ms
+
+POOL_SHAPES = {
+    # (N label appended later) pool1/pool2 geometries from the model config.
+    "pool1": ((55, 55, 96), 3, 2),
+    "pool2": ((27, 27, 256), 3, 2),
+}
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def pool_xla(x, *, window: int, stride: int):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, window, window, 1), (1, stride, stride, 1), "VALID"
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("stride", "hp", "wp"))
+def phases_only(x, *, stride: int, hp: int, wp: int):
+    return pk._pool_phases(x, stride, hp, wp)
+
+
+def _s2d_pool_kernel(x_ref, o_ref, *, window: int, stride: int, ho: int, wo: int, cp: int):
+    s = stride
+    out = None
+    for fy in range(window):
+        for fx in range(window):
+            ph = (fy % s) * s + (fx % s)
+            qh, qw = fy // s, fx // s
+            win = x_ref[0, qh : qh + ho, qw : qw + wo, ph * cp : (ph + 1) * cp]
+            out = win if out is None else jnp.maximum(out, win)
+    o_ref[0] = out
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def pool_s2d128(x, *, window: int, stride: int):
+    """Space-to-depth pool: pad C to a 128 multiple, repack via
+    reshape+transpose (no strided gather), lane-aligned kernel slices."""
+    n, h, w, c = x.shape
+    s = stride
+    ho = (h - window) // s + 1
+    wo = (w - window) // s + 1
+    cp = -(-c // 128) * 128
+    qmax = (window - 1) // s
+    hs, ws = ho + qmax, wo + qmax  # s2d rows/cols the kernel reads
+    if cp != c:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, cp - c)))
+    xs = pk._space_to_depth(x, s, hs, ws)  # (N, hs, ws, s*s*cp)
+    kernel = functools.partial(
+        _s2d_pool_kernel, window=window, stride=s, ho=ho, wo=wo, cp=cp
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[pk._vmem_spec((1, hs, ws, s * s * cp), lambda i: (i, 0, 0, 0))],
+        out_specs=pk._vmem_spec((1, ho, wo, cp), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, ho, wo, cp), x.dtype),
+        compiler_params=pk._tc_params("parallel"),
+        interpret=pk._interpret(),
+    )(xs)
+    return out[..., :c] if cp != c else out
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride"))
+def pool_sep2p(x, *, window: int, stride: int):
+    """sep2 with C zero-padded to a 128-lane multiple first: trades one
+    +33% pad pass (96->128) for fully aligned tiles in both stages and
+    both transposes. Padding is harmless for max: the pooled max over a
+    zero-padded channel is just 0 there, and we crop before returning."""
+    n, h, w, c = x.shape
+    cp = -(-c // 128) * 128
+    if cp != c:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, cp - c)))
+    out = pk._maxpool_sep2(x, window=window, stride=stride)
+    return out[..., :c] if cp != c else out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--dtype", choices=("fp32", "bf16"), default="fp32")
+    ap.add_argument("--pool", choices=tuple(POOL_SHAPES), default="pool1")
+    args = ap.parse_args()
+
+    (h, w, c), window, stride = POOL_SHAPES[args.pool]
+    dt = jnp.float32 if args.dtype == "fp32" else jnp.bfloat16
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (args.batch, h, w, c), dt)
+
+    oracle = np.asarray(pool_xla(x, window=window, stride=stride))
+    qmax = (window - 1) // stride
+    ho = (h - window) // stride + 1
+    hp, wp = ho + qmax, ho + qmax
+
+    strategies = {
+        "xla": lambda: pool_xla(x, window=window, stride=stride),
+        "current": lambda: pk._maxpool_phases(x, window=window, stride=stride),
+        "phases": lambda: phases_only(x, stride=stride, hp=hp, wp=wp),
+        "s2d128": lambda: pool_s2d128(x, window=window, stride=stride),
+        "sep2": lambda: pk._maxpool_sep2(x, window=window, stride=stride),
+        "sep2p": lambda: pool_sep2p(x, window=window, stride=stride),
+    }
+    rc = 0
+    for name, fn in strategies.items():
+        try:
+            ms = amortized_ms(lambda _x: fn(), x, n_small=10, n_large=60)
+            row = {"strategy": name, "pool": args.pool, "batch": args.batch,
+                   "dtype": args.dtype, "ms_per_pass": round(ms, 4)}
+            if name not in ("phases",):
+                got = np.asarray(fn())
+                if not np.array_equal(got, oracle):
+                    row["mismatch"] = True
+                    rc = 1
+        except Exception as e:  # noqa: BLE001 — report per-strategy failures
+            row = {"strategy": name, "pool": args.pool, "error": repr(e)[:200]}
+            rc = 1
+        print(json.dumps(row), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -165,3 +165,62 @@ def test_conv_fused_variant_matches_taps(monkeypatch):
     assert taps.shape == fused.shape == (2, 6, 6, 16)
     np.testing.assert_allclose(fused, taps, rtol=1e-5, atol=1e-6)
     np.testing.assert_array_equal(empty, taps)  # same variant, same bits
+
+
+def test_pool_variant_sep2_matches_phases(monkeypatch):
+    """The sep2 (separable two-stage) default and the phase-stack variant
+    are BITWISE equal: max is associative and exact in floating point, so
+    the stage split cannot change results. Covers odd H/W, both pool
+    geometries of the model, and an uneven window=2 case."""
+    for shape, window, stride in (
+        ((2, 55, 55, 96), 3, 2),
+        ((2, 27, 27, 256), 3, 2),
+        ((1, 11, 13, 4), 2, 2),
+        ((1, 9, 9, 8), 3, 3),
+    ):
+        x = jax.random.normal(jax.random.PRNGKey(7), shape, jnp.float32)
+        monkeypatch.delenv("TPU_FRAMEWORK_POOL", raising=False)
+        sep2 = np.asarray(pk.maxpool_pallas(x, window=window, stride=stride))
+        monkeypatch.setenv("TPU_FRAMEWORK_POOL", "phases")
+        phases = np.asarray(pk.maxpool_pallas(x, window=window, stride=stride))
+        np.testing.assert_array_equal(sep2, phases)
+
+
+def test_pool_variant_rejects_unknown(monkeypatch):
+    monkeypatch.setenv("TPU_FRAMEWORK_POOL", "quadtree")
+    x = jnp.ones((1, 8, 8, 4))
+    with pytest.raises(ValueError, match="TPU_FRAMEWORK_POOL"):
+        pk.maxpool_pallas(x, window=3, stride=2)
+
+
+def test_chain_variant_pad128_bitwise(monkeypatch):
+    """TPU_FRAMEWORK_CHAIN=pad128 (channel axis padded 96->128 through
+    block 1) vs the plain chain. Padded lanes carry exact zeros through
+    conv1 and contribute exact +0.0 terms to conv2's accumulation, so on
+    TPU — where Mosaic's matmul accumulation order is fixed — the two
+    chains are BITWISE equal (verified on a real v5e). XLA's CPU matmul
+    retiles the larger contraction across its threadpool (the 8-device
+    test mesh splits it further), reassociating the sum by ~1 ulp, so the
+    interpreter-mode assertion is tight-allclose instead. Measured on
+    v5e: no wall-clock delta (docs/PALLAS_PERF.md); kept as a layout
+    experiment."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_model as pm
+
+    p = init_params_deterministic()
+    x = deterministic_input(batch=2)
+    monkeypatch.delenv("TPU_FRAMEWORK_CHAIN", raising=False)
+    plain = np.asarray(pm.forward_blocks12_pallas(p, x))
+    monkeypatch.setenv("TPU_FRAMEWORK_CHAIN", "pad128")
+    padded = np.asarray(pm.forward_blocks12_pallas(p, x))
+    if jax.default_backend() == "tpu":
+        np.testing.assert_array_equal(plain, padded)
+    else:
+        np.testing.assert_allclose(padded, plain, rtol=1e-6, atol=2e-5)
+
+
+def test_chain_variant_rejects_unknown(monkeypatch):
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_model as pm
+
+    monkeypatch.setenv("TPU_FRAMEWORK_CHAIN", "pad256")
+    with pytest.raises(ValueError, match="TPU_FRAMEWORK_CHAIN"):
+        pm.forward_blocks12_pallas(init_params_deterministic(), deterministic_input(batch=1))
