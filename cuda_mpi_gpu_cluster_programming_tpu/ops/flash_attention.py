@@ -107,7 +107,7 @@ def _fwd_kernel(
         m = m_sc[:, 0]
         den = jnp.maximum(den_sc[:, 0], 1e-30)
         o_ref[0, 0] = (acc_sc[...] / den[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m + jnp.log(den)
+        lse_ref[0, 0, 0] = m + jnp.log(den)
 
 
 # Lane width of the (bq,)-shaped running stats held in VMEM scratch: Mosaic
@@ -139,11 +139,17 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, return_lse):
         ],
         out_specs=[
             _spec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            _spec((1, 1, bq), lambda bi, hi, qi, ki: (bi, hi, qi)),
+            # LSE rides as (B, H, 1, L): Mosaic requires the block's last two
+            # dims to be (sublane-divisible | equal-to-array), which a
+            # (1, 1, bq) block over (B, H, L) violates (H is second-minor).
+            # The explicit singleton makes the block (1, bq) vs array (1, L)
+            # — legal, and caught only on real TPU (interpret mode doesn't
+            # enforce tiling).
+            _spec((1, 1, 1, bq), lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, l), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, l), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running max
@@ -171,7 +177,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, bq, bk, causal, scale):
     )
     if causal:
         s = _causal_mask(s, qi, ki, bq, bk)
-    return jnp.exp(s - lse_ref[0, 0][:, None])  # (bq, bk)
+    return jnp.exp(s - lse_ref[0, 0, 0][:, None])  # (bq, bk)
 
 
 def _dq_kernel(
@@ -196,7 +202,7 @@ def _dq_kernel(
         dp = lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
-        ds = p * (dp - delta_ref[0, 0][:, None])  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0, 0][:, None])  # (bq, bk)
         dq_sc[...] += scale * lax.dot_general(
             ds, k_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -234,7 +240,7 @@ def _dkv_kernel(
         dp = lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta_ref[0, 0, 0][:, None])
         dk_sc[...] += scale * lax.dot_general(
             ds, q_ref[0, 0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -259,11 +265,15 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
     gt = jnp.transpose(g, (0, 2, 1, 3))
 
     # delta_i = sum_d dO_i * O_i — O(L) rowwise term of dS (FA-2 eq. 4).
-    delta = jnp.sum(gt.astype(jnp.float32) * dot.astype(jnp.float32), axis=-1)  # (b,h,l)
+    # (b, h, 1, l) — same explicit-singleton layout as the LSE (see the
+    # forward out_specs note on Mosaic's block tiling rule).
+    delta = jnp.sum(
+        gt.astype(jnp.float32) * dot.astype(jnp.float32), axis=-1, keepdims=True
+    ).swapaxes(-1, -2)
 
     qb = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     kb = lambda bi, hi, qi, ki: (bi, hi, ki, 0)
-    rowq = lambda bi, hi, qi, ki: (bi, hi, qi)
+    rowq = lambda bi, hi, qi, ki: (bi, hi, 0, qi)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, causal=causal, scale=scale),
@@ -273,8 +283,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
             _spec((1, 1, bk, d), kb),
             _spec((1, 1, bk, d), kb),
             _spec((1, 1, bq, d), qb),
-            _spec((1, 1, bq), rowq),
-            _spec((1, 1, bq), rowq),
+            _spec((1, 1, 1, bq), rowq),
+            _spec((1, 1, 1, bq), rowq),
         ],
         out_specs=_spec((1, 1, bq, d), qb),
         out_shape=jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
@@ -285,7 +295,7 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
     # k-block outer, q-block streamed innermost.
     kb2 = lambda bi, hi, ki, qi: (bi, hi, ki, 0)
     qb2 = lambda bi, hi, ki, qi: (bi, hi, qi, 0)
-    rowq2 = lambda bi, hi, ki, qi: (bi, hi, qi)
+    rowq2 = lambda bi, hi, ki, qi: (bi, hi, 0, qi)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, causal=causal, scale=scale),
         grid=(b, h, l // bk, l // bq),
@@ -294,8 +304,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
             _spec((1, 1, bk, d), kb2),
             _spec((1, 1, bq, d), qb2),
             _spec((1, 1, bq, d), qb2),
-            _spec((1, 1, bq), rowq2),
-            _spec((1, 1, bq), rowq2),
+            _spec((1, 1, 1, bq), rowq2),
+            _spec((1, 1, 1, bq), rowq2),
         ],
         out_specs=[
             _spec((1, 1, bk, d), kb2),

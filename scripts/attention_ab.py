@@ -1,0 +1,113 @@
+"""On-chip A/B of the Pallas flash-attention kernel vs XLA reference attention.
+
+The long-context tier's within-chip engine (`ops/flash_attention.py`) was
+validated for correctness on the CPU mesh in round 2 but never measured on
+the real chip. This script times forward and forward+backward at growing
+sequence lengths against `ops.attention.attention` (which materializes the
+full (L, L) score matrix in HBM) and reports where the O(L)-memory kernel
+overtakes — plus the longest L each path can run at all, the capability
+argument for flash (the reference workload has no attention; this tier is
+the framework's long-context extension, SURVEY §5.7).
+
+Usage: python scripts/attention_ab.py [--dtype bf16] [--heads 8] [--dim 128]
+One JSON line per (L, path, mode); `oom`/`error` rows record capability
+limits instead of aborting the sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_mpi_gpu_cluster_programming_tpu.ops.attention import attention
+from cuda_mpi_gpu_cluster_programming_tpu.ops.flash_attention import flash_attention
+from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_ms
+
+
+def attn_flops(batch: int, length: int, heads: int, dim: int, *, causal: bool) -> int:
+    """Matmul FLOPs: QK^T and PV, each 2*B*H*L^2*D (halved if causal)."""
+    f = 2 * 2 * batch * heads * length * length * dim
+    return f // 2 if causal else f
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--dtype", choices=("fp32", "bf16"), default="bf16")
+    ap.add_argument("--lengths", default="512,1024,2048,4096,8192")
+    ap.add_argument("--causal", action="store_true")
+    args = ap.parse_args()
+
+    dt = jnp.float32 if args.dtype == "fp32" else jnp.bfloat16
+    lengths = [int(s) for s in args.lengths.split(",")]
+    causal = bool(args.causal)
+
+    @functools.partial(jax.jit, static_argnames=("path",))
+    def fwd(q, k, v, path: str):
+        if path == "flash":
+            return flash_attention(q, k, v, causal=causal)
+        return attention(q, k, v, causal=causal)
+
+    @functools.partial(jax.jit, static_argnames=("path",))
+    def fwdbwd(q, k, v, path: str):
+        def loss(q, k, v):
+            if path == "flash":
+                return flash_attention(q, k, v, causal=causal).astype(jnp.float32).sum()
+            return attention(q, k, v, causal=causal).astype(jnp.float32).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    rc = 0
+    for L in lengths:
+        key = jax.random.PRNGKey(L)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (args.batch, L, args.heads, args.dim)
+        q = jax.random.normal(kq, shape, dt)
+        k = jax.random.normal(kk, shape, dt)
+        v = jax.random.normal(kv, shape, dt)
+
+        # agreement check once per L (bf16 tolerance: online softmax reorders)
+        try:
+            ref = np.asarray(fwd(q, k, v, "ref"), np.float32)
+            got = np.asarray(fwd(q, k, v, "flash"), np.float32)
+            tol = 2e-2 if dt == jnp.bfloat16 else 2e-5
+            ok = bool(np.allclose(got, ref, rtol=tol, atol=tol))
+        except Exception:
+            ok = None  # one path can't even run at this L; rows below record who
+
+        for mode, fn in (("fwd", fwd), ("fwdbwd", fwdbwd)):
+            for path in ("ref", "flash"):
+                row = {"L": L, "path": path, "mode": mode, "dtype": args.dtype,
+                       "batch": args.batch, "heads": args.heads, "dim": args.dim,
+                       "causal": causal, "agree": ok}
+                try:
+                    ms = amortized_ms(
+                        lambda q, k, v: fn(q, k, v, path), q, k, v,
+                        n_small=4, n_large=24,
+                    )
+                    row["ms"] = round(ms, 3)
+                    fl = attn_flops(args.batch, L, args.heads, args.dim, causal=causal)
+                    if mode == "fwdbwd":
+                        fl *= 3  # bwd ~2x fwd matmul work (dQ, dK/dV recompute)
+                    row["eff_tflops"] = round(fl / (ms * 1e-3) / 1e12, 2)
+                except Exception as e:  # noqa: BLE001 — record capability limits
+                    msg = repr(e)
+                    row["error"] = ("OOM" if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
+                                    else msg[:160])
+                print(json.dumps(row), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
