@@ -98,7 +98,15 @@ def main() -> int:
         run(
             tag,
             [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.harness",
-             "--configs", "v1_jit,v3_pallas" + ("" if args.quick else ",v6_full_jit,v6_full_pallas"),
+             # Full capture also measures the sharded-family configs at
+             # shards=1 (the reference's own np=1 rows are the comparison
+             # set; one chip = one shard, multi-shard correctness is the
+             # CPU-mesh suite's job).
+             "--configs", "v1_jit,v3_pallas" + (
+                 "" if args.quick
+                 else ",v6_full_jit,v6_full_pallas,v6_full_sharded,"
+                      "v2.1_replicated,v2.2_sharded,v4_hybrid,v5_collective,v7_tp"
+             ),
              "--shards", "1",
              "--batches", batches, "--computes", computes,
              "--timeout", "600", "--repeats", "50"],
