@@ -25,6 +25,8 @@ from __future__ import annotations
 import argparse
 import csv
 import hashlib
+import json
+import re
 import sqlite3
 import statistics
 import sys
@@ -75,6 +77,38 @@ class _Stdev:
         return statistics.stdev(self.vals) if len(self.vals) > 1 else None
 
 
+def _backfill_platform(conn: sqlite3.Connection) -> None:
+    """One-time migration companion for the platform column: derive it for
+    rows ingested before the column existed. The sha1-incremental ingest
+    never revisits unchanged CSVs, so without this an upgraded warehouse
+    would keep pooling its old CPU and TPU rows in one NULL-platform group
+    — the exact conflation the column exists to fix."""
+    rows = conn.execute(
+        "SELECT rowid, src_csv, log_file, corpus FROM summary_runs "
+        "WHERE platform IS NULL"
+    ).fetchall()
+    defaults: dict = {}
+    n = 0
+    for rowid, src_csv, log_file, corpus in rows:
+        is_ref = corpus == "reference" or (
+            corpus is None and src_csv
+            and ("/reference/" in src_csv or "reference_import" in src_csv)
+        )
+        if is_ref or not src_csv:
+            continue  # reference rows stay NULL (platform encoded in variant)
+        csv_path = Path(src_csv)
+        if csv_path not in defaults:
+            defaults[csv_path] = _session_platform(csv_path)
+        p = _row_platform({"LogFile": log_file}, csv_path, defaults[csv_path])
+        if p:
+            conn.execute(
+                "UPDATE summary_runs SET platform=? WHERE rowid=?", (p, rowid)
+            )
+            n += 1
+    if n:
+        print(f"backfilled platform for {n} pre-migration rows", file=sys.stderr)
+
+
 def connect(db_path: str | Path) -> sqlite3.Connection:
     path = Path(db_path)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -90,7 +124,7 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
             variant TEXT, config_key TEXT, np INTEGER, batch INTEGER,
             build_status TEXT, run_status TEXT, parse_status TEXT, status TEXT,
             time_ms REAL, compile_ms REAL, shape TEXT, first5 TEXT,
-            log_file TEXT, src_csv TEXT, corpus TEXT
+            log_file TEXT, src_csv TEXT, corpus TEXT, platform TEXT
         );
         CREATE TABLE IF NOT EXISTS run_logs (
             path TEXT, session_id TEXT, time_ms REAL, shape TEXT
@@ -106,6 +140,9 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
     cols = {r[1] for r in conn.execute("PRAGMA table_info(summary_runs)")}
     if "corpus" not in cols:  # pragma: no cover — legacy DB only
         conn.execute("ALTER TABLE summary_runs ADD COLUMN corpus TEXT")
+    if "platform" not in cols:
+        conn.execute("ALTER TABLE summary_runs ADD COLUMN platform TEXT")
+        _backfill_platform(conn)
     conn.executescript(
         """
         DROP VIEW IF EXISTS perf_runs;
@@ -117,20 +154,26 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
                    COALESCE(corpus,
                        CASE WHEN src_csv LIKE '%/reference/%'
                               OR src_csv LIKE '%reference_import%'
-                            THEN 'reference' ELSE 'local' END) AS corpus
+                            THEN 'reference' ELSE 'local' END) AS corpus,
+                   platform
             FROM summary_runs
             WHERE status = 'OK' AND time_ms IS NOT NULL;
+        -- Grouping includes platform: one machine's sessions span the CPU
+        -- fallback and the tunneled TPU; pooling 11 ms CPU passes with
+        -- 0.3 ms TPU passes would fabricate wild stddevs and meaningless
+        -- baselines (NULL platform = pre-column or reference rows, which
+        -- group among themselves per corpus).
         CREATE VIEW best_runs AS
             SELECT variant, np, batch, MIN(time_ms) AS best_ms, COUNT(*) AS n,
-                   corpus
-            FROM perf_runs GROUP BY corpus, variant, np, batch;
+                   corpus, platform
+            FROM perf_runs GROUP BY corpus, platform, variant, np, batch;
         CREATE VIEW run_stats AS
             SELECT variant, np, batch, COUNT(*) AS n,
                    AVG(time_ms) AS mean_ms,
                    stddev_samp(time_ms) AS stdev_ms,
                    1.96 * stddev_samp(time_ms) / SQRT(COUNT(*)) AS ci95_ms,
-                   corpus
-            FROM perf_runs GROUP BY corpus, variant, np, batch;
+                   corpus, platform
+            FROM perf_runs GROUP BY corpus, platform, variant, np, batch;
         """
     )
     return conn
@@ -213,6 +256,42 @@ def _normalize_row(r: dict) -> dict:
     return r
 
 
+_RE_DEVICES = re.compile(r"Devices: \d+ x .+ \((\w+)\)")
+
+
+def _session_platform(csv_path: Path) -> Optional[str]:
+    """Session-level platform fallback from the harness's env.json dump
+    ('axon' is the tunneled TPU registration — see the verify skill)."""
+    try:
+        env = json.loads((csv_path.parent / "env.json").read_text()).get("env", {})
+    except (OSError, ValueError):
+        return None
+    # JAX_PLATFORMS is a comma-separated priority list; the first entry is
+    # the effective backend ('axon,cpu' must not mint a separate group).
+    jp = str(env.get("JAX_PLATFORMS", "")).lower().split(",")[0].strip()
+    if jp in ("axon", "tpu"):
+        return "tpu"
+    return jp or None
+
+
+def _row_platform(r: dict, csv_path: Path, session_default: Optional[str]) -> Optional[str]:
+    """Per-row platform: the run log's 'Devices: N x <kind> (<platform>)'
+    line is authoritative (a session could mix backends); fall back to the
+    session env. Reference-corpus rows get NULL — their platform axis
+    (CPU vs CUDA) is already encoded in the variant name."""
+    if r.get("_corpus") == "reference":
+        return None
+    log = r.get("LogFile")
+    if log:
+        try:
+            m = _RE_DEVICES.search((csv_path.parent / log).read_text(errors="replace"))
+            if m:
+                return m.group(1).lower()
+        except OSError:
+            pass
+    return session_default
+
+
 def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
     """Load one summary CSV — ours (harness.CSV_COLUMNS) or either of the
     reference's two schema generations, so historical reference data and new
@@ -220,10 +299,11 @@ def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
     with open(path, newline="") as f:
         rows = [_normalize_row(r) for r in csv.DictReader(f)]
     conn.execute("DELETE FROM summary_runs WHERE src_csv=?", (str(path),))
+    session_default = _session_platform(path)
     n = 0
     for r in rows:
         conn.execute(
-            "INSERT INTO summary_runs VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            "INSERT INTO summary_runs VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
             (
                 r.get("SessionID"),
                 r.get("MachineID"),
@@ -244,6 +324,7 @@ def ingest_summary_csv(conn: sqlite3.Connection, path: Path) -> int:
                 r.get("LogFile"),
                 str(path),
                 r.get("_corpus", "local"),
+                _row_platform(r, path, session_default),
             ),
         )
         n += 1
@@ -336,28 +417,34 @@ def cmd_ingest(conn: sqlite3.Connection, logs_root: Path, repo_root: Optional[Pa
 
 SPEEDUP_SQL = """
 WITH base AS (
-    SELECT corpus, COALESCE(batch, 1) AS batch, MIN(best_ms) AS t1_ms
+    SELECT corpus, COALESCE(platform, '') AS platform,
+           COALESCE(batch, 1) AS batch, MIN(best_ms) AS t1_ms
     FROM best_runs
-    WHERE variant = ? AND np = 1 GROUP BY corpus, COALESCE(batch, 1)
+    WHERE variant = ? AND np = 1
+    GROUP BY corpus, COALESCE(platform, ''), COALESCE(batch, 1)
 )
 SELECT b.variant, b.np, b.batch, b.best_ms,
        base.t1_ms / b.best_ms AS speedup,
        base.t1_ms / b.best_ms / b.np AS efficiency,
-       b.corpus
+       b.corpus, b.platform
 FROM best_runs b
-JOIN base ON base.corpus = b.corpus AND base.batch = COALESCE(b.batch, 1)
-ORDER BY b.corpus, b.variant, b.batch, b.np
+JOIN base ON base.corpus = b.corpus
+         AND base.platform = COALESCE(b.platform, '')
+         AND base.batch = COALESCE(b.batch, 1)
+ORDER BY b.corpus, b.platform, b.variant, b.batch, b.np
 """
 # batch NULL (the reference corpus has no batch column; it is batch-1 by
 # construction) is COALESCEd to 1 so historical reference rows and new
 # batch-1 TPU rows share one per-image baseline. Rows at other batch sizes
 # still require a same-batch np=1 baseline — no silent cross-batch ratios.
 # The baseline T1 is additionally grouped PER CORPUS (reference-ingested
-# CSVs vs this repo's own sessions, derived from src_csv origin): the
-# reference's hardware and this repo's TPU must each be judged against
-# their own serial baseline — mirroring log_analysis.py:213-222, which
-# only ever saw one corpus. Cross-corpus comparison stays available via
-# the raw best_runs view (both corpora share the variant axis).
+# CSVs vs this repo's own sessions, derived from src_csv origin) AND PER
+# PLATFORM (one machine's local sessions span the CPU fallback and the
+# tunneled TPU — a 0.3 ms TPU run must not be "sped up" against an 11 ms
+# CPU baseline): each (corpus, platform) group is judged against its own
+# serial baseline — mirroring log_analysis.py:213-222, which only ever
+# saw one corpus on one backend. Cross-corpus/platform comparison stays
+# available via the raw best_runs view (all share the variant axis).
 
 
 def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
@@ -365,32 +452,36 @@ def cmd_speedup(conn: sqlite3.Connection, baseline: str) -> List[tuple]:
     if not rows:
         print(f"no data (is there a '{baseline}' np=1 run ingested?)", file=sys.stderr)
         return []
-    print(f"{'variant':22s} {'np':>3s} {'batch':>5s} {'best_ms':>10s} {'S(N)':>7s} {'E(N)':>6s} {'corpus':>9s}")
-    for v, np_, b, ms, s, e, corpus in rows:
+    print(
+        f"{'variant':22s} {'np':>3s} {'batch':>5s} {'best_ms':>10s} {'S(N)':>7s} "
+        f"{'E(N)':>6s} {'corpus':>9s} {'platform':>8s}"
+    )
+    for v, np_, b, ms, s, e, corpus, platform in rows:
         # batch is NULL for reference-corpus rows (the reference is batch-1
         # with no batch column).
         print(
             f"{v:22s} {np_:3d} {str(b) if b is not None else '-':>5s} "
-            f"{ms:10.3f} {s:7.2f} {e:6.2f} {corpus:>9s}"
+            f"{ms:10.3f} {s:7.2f} {e:6.2f} {corpus:>9s} {platform or '-':>8s}"
         )
     return rows
 
 
 def cmd_stats(conn: sqlite3.Connection) -> None:
     rows = conn.execute(
-        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms, corpus FROM run_stats "
-        "ORDER BY corpus, variant, batch, np"
+        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms, corpus, platform "
+        "FROM run_stats ORDER BY corpus, platform, variant, batch, np"
     ).fetchall()
     print(
         f"{'variant':22s} {'np':>3s} {'batch':>5s} {'n':>4s} {'mean_ms':>10s} "
-        f"{'stdev':>8s} {'ci95':>8s} {'corpus':>9s}"
+        f"{'stdev':>8s} {'ci95':>8s} {'corpus':>9s} {'platform':>8s}"
     )
-    for v, np_, b, n, mean, sd, ci, corpus in rows:
+    for v, np_, b, n, mean, sd, ci, corpus, platform in rows:
         # batch NULL = the (batch-1) reference corpus; '-' like the other
         # commands, never a fabricated 0.
         print(
             f"{v:22s} {np_:3d} {str(b) if b is not None else '-':>5s} {n:4d} "
-            f"{mean:10.3f} {sd or 0:8.3f} {ci or 0:8.3f} {corpus:>9s}"
+            f"{mean:10.3f} {sd or 0:8.3f} {ci or 0:8.3f} {corpus:>9s} "
+            f"{platform or '-':>8s}"
         )
 
 
@@ -405,15 +496,15 @@ def cmd_plot(conn: sqlite3.Connection, out_dir: Path, baseline: str) -> None:
         print("no data to plot", file=sys.stderr)
         return
     out_dir.mkdir(parents=True, exist_ok=True)
-    corpora = {r[6] for r in rows}
+    groups = {(r[6], r[7]) for r in rows}
     by_variant: dict = {}
-    for v, np_, b, ms, s, e, corpus in rows:
+    for v, np_, b, ms, s, e, corpus, platform in rows:
         # batch NULL = the (batch-1) reference corpus; normalize so mixed
-        # corpora sort and label consistently. Corpus only appears in the
-        # label when the warehouse actually holds more than one.
+        # corpora sort and label consistently. The corpus/platform tag only
+        # appears when the warehouse actually holds more than one group.
         label = f"{v} (b={b if b is not None else 1})"
-        if len(corpora) > 1:
-            label += f" [{corpus}]"
+        if len(groups) > 1:
+            label += f" [{corpus}{'/' + platform if platform else ''}]"
         by_variant.setdefault(label, []).append((np_, s, e))
     for idx, (title, ylab, fname) in enumerate(
         [("Speedup vs serial baseline", "S(N) = T1/TN", "speedup.png"),
@@ -469,42 +560,46 @@ def cmd_report(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
     lines.append("")
     lines.append("## Best runs (min time per variant / np / batch)")
     lines.append("")
-    lines.append("| variant | np | batch | best_ms | img/s | n | corpus |")
-    lines.append("|---|---:|---:|---:|---:|---:|---|")
-    for v, np_, b, ms, n, corpus in conn.execute(
-        "SELECT variant, np, batch, best_ms, n, corpus FROM best_runs "
-        "ORDER BY corpus, variant, batch, np"
+    lines.append("| variant | np | batch | best_ms | img/s | n | corpus | platform |")
+    lines.append("|---|---:|---:|---:|---:|---:|---|---|")
+    for v, np_, b, ms, n, corpus, platform in conn.execute(
+        "SELECT variant, np, batch, best_ms, n, corpus, platform FROM best_runs "
+        "ORDER BY corpus, platform, variant, batch, np"
     ):
         imgs = (b or 1) / (ms / 1e3) if ms else 0.0
         lines.append(
             f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {imgs:.1f} "
-            f"| {n} | {corpus} |"
+            f"| {n} | {corpus} | {platform or '-'} |"
         )
 
     lines.append("")
-    lines.append(f"## Speedup & efficiency vs `{baseline}` (np=1, same batch, same corpus)")
+    lines.append(
+        f"## Speedup & efficiency vs `{baseline}` (np=1, same batch, same corpus+platform)"
+    )
     lines.append("")
-    lines.append("| variant | np | batch | best_ms | S(N) | E(N) | corpus |")
-    lines.append("|---|---:|---:|---:|---:|---:|---|")
-    for v, np_, b, ms, s, e, corpus in conn.execute(SPEEDUP_SQL, (baseline,)):
+    lines.append("| variant | np | batch | best_ms | S(N) | E(N) | corpus | platform |")
+    lines.append("|---|---:|---:|---:|---:|---:|---|---|")
+    for v, np_, b, ms, s, e, corpus, platform in conn.execute(SPEEDUP_SQL, (baseline,)):
         lines.append(
             f"| {v} | {np_} | {b if b is not None else '-'} | {ms:.3f} | {s:.2f} "
-            f"| {e:.2f} | {corpus} |"
+            f"| {e:.2f} | {corpus} | {platform or '-'} |"
         )
 
     lines.append("")
     lines.append("## Run statistics (mean / stddev / 95% CI)")
     lines.append("")
-    lines.append("| variant | np | batch | n | mean_ms | stdev_ms | ci95_ms | corpus |")
-    lines.append("|---|---:|---:|---:|---:|---:|---:|---|")
-    for v, np_, b, n, mean, sd, ci, corpus in conn.execute(
-        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms, corpus FROM run_stats "
-        "ORDER BY corpus, variant, batch, np"
+    lines.append(
+        "| variant | np | batch | n | mean_ms | stdev_ms | ci95_ms | corpus | platform |"
+    )
+    lines.append("|---|---:|---:|---:|---:|---:|---:|---|---|")
+    for v, np_, b, n, mean, sd, ci, corpus, platform in conn.execute(
+        "SELECT variant, np, batch, n, mean_ms, stdev_ms, ci95_ms, corpus, platform "
+        "FROM run_stats ORDER BY corpus, platform, variant, batch, np"
     ):
         lines.append(
             f"| {v} | {np_} | {b if b is not None else '-'} | {n} | {mean:.3f} "
             f"| {f'{sd:.3f}' if sd is not None else '-'} "
-            f"| {f'{ci:.3f}' if ci is not None else '-'} | {corpus} |"
+            f"| {f'{ci:.3f}' if ci is not None else '-'} | {corpus} | {platform or '-'} |"
         )
 
     lines.append("")

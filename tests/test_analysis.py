@@ -51,8 +51,10 @@ def test_ingest_views_and_dedup(tmp_path):
         for r in conn.execute("SELECT variant, np, batch, best_ms FROM best_runs")
     )
     assert best[("V1 Serial", 1)] == 100.0
-    # run_stats: mean/stddev/ci over V1 Serial
-    v, np_, b, n, mean, sd, ci, corpus = conn.execute(
+    # run_stats: mean/stddev/ci over V1 Serial (platform column appended
+    # round 3 — one machine's sessions span CPU fallback and tunneled TPU,
+    # so stats group per platform)
+    v, np_, b, n, mean, sd, ci, corpus, platform = conn.execute(
         "SELECT * FROM run_stats WHERE variant='V1 Serial'"
     ).fetchone()
     assert corpus == "local"
@@ -232,3 +234,89 @@ def test_cli_end_to_end(tmp_path, capsys):
     assert analysis.main(["--db", db, "speedup"]) == 0
     out = capsys.readouterr().out
     assert "V2.2 ScatterHalo" in out and "4.00" in out
+
+
+def test_platform_split_stats_and_baselines(tmp_path):
+    """One machine's sessions span the CPU fallback and the tunneled TPU;
+    stats and speedup baselines must group per platform — pooling 11 ms CPU
+    passes with 0.3 ms TPU passes fabricates wild stddevs and judges TPU
+    rows against a CPU baseline. Platform comes from the run log's
+    'Devices: N x <kind> (<platform>)' line, falling back to the session
+    env.json JAX_PLATFORMS ('axon' = tunneled TPU)."""
+    import json
+
+    for sid, platform, ms in (("scpu", "cpu", 100.0), ("stpu", "tpu", 1.0)):
+        session = harness.Session(
+            log_root=tmp_path / "logs", session_id=sid, machine_id="m1"
+        )
+        for t in (ms, ms * 1.2):
+            r = harness.CaseResult("V1 Serial", "v1_jit", 1, 1)
+            r.run_status = harness.OK
+            r.time_ms = t
+            r.shape = "13x13x256"
+            r.log_file = "run_v1.log"
+            session.log_row(r)
+        kind = "TPU v5 lite (tpu)" if platform == "tpu" else "cpu (cpu)"
+        (session.dir / "run_v1.log").write_text(f"Devices: 1 x {kind}\n")
+        (session.dir / "env.json").write_text(
+            json.dumps({"env": {"JAX_PLATFORMS": "axon" if platform == "tpu" else "cpu"}})
+        )
+
+    conn = analysis.connect(tmp_path / "w.sqlite")
+    analysis.cmd_ingest(conn, tmp_path / "logs", None)
+    stats = {
+        row[-1]: row
+        for row in conn.execute("SELECT * FROM run_stats WHERE variant='V1 Serial'")
+    }
+    assert set(stats) == {"cpu", "tpu"}  # two groups, not one pooled mess
+    assert stats["cpu"][3] == 2 and abs(stats["cpu"][4] - 110.0) < 1e-9
+    assert stats["tpu"][3] == 2 and abs(stats["tpu"][4] - 1.1) < 1e-9
+    # each platform gets its own T1 baseline: both np=1 rows show S(N)=1.0
+    rows = analysis.cmd_speedup(conn, "V1 Serial")
+    speedups = {r[7]: r[4] for r in rows if r[0] == "V1 Serial"}
+    assert abs(speedups["cpu"] - 1.0) < 1e-9
+    assert abs(speedups["tpu"] - 1.0) < 1e-9
+    conn.close()
+
+
+def test_platform_backfill_on_legacy_warehouse(tmp_path):
+    """Opening a pre-platform-column warehouse backfills the column from
+    the recorded src_csv/log_file paths — the sha1-incremental ingest never
+    revisits unchanged CSVs, so without the backfill old CPU and TPU rows
+    would pool in one NULL-platform group forever."""
+    import json
+    import sqlite3
+
+    session = harness.Session(log_root=tmp_path / "logs", session_id="s1", machine_id="m1")
+    r = harness.CaseResult("V1 Serial", "v1_jit", 1, 1)
+    r.run_status = harness.OK
+    r.time_ms = 1.0
+    r.log_file = "run_v1.log"
+    session.log_row(r)
+    (session.dir / "run_v1.log").write_text("Devices: 1 x TPU v5 lite (tpu)\n")
+    (session.dir / "env.json").write_text(json.dumps({"env": {"JAX_PLATFORMS": "axon,cpu"}}))
+
+    # Build a legacy warehouse by hand: no platform column, row pre-ingested.
+    db = tmp_path / "w.sqlite"
+    legacy = sqlite3.connect(db)
+    legacy.execute(
+        "CREATE TABLE summary_runs ("
+        "session_id TEXT, machine_id TEXT, git_commit TEXT, ts TEXT,"
+        "variant TEXT, config_key TEXT, np INTEGER, batch INTEGER,"
+        "build_status TEXT, run_status TEXT, parse_status TEXT, status TEXT,"
+        "time_ms REAL, compile_ms REAL, shape TEXT, first5 TEXT,"
+        "log_file TEXT, src_csv TEXT, corpus TEXT)"
+    )
+    legacy.execute(
+        "INSERT INTO summary_runs VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+        ("s1", "m1", None, None, "V1 Serial", "v1_jit", 1, 1, "OK", "OK", "OK",
+         "OK", 1.0, None, "13x13x256", None, "run_v1.log",
+         str(session.dir / "summary.csv"), "local"),
+    )
+    legacy.commit()
+    legacy.close()
+
+    conn = analysis.connect(db)  # migration: ALTER + backfill
+    got = conn.execute("SELECT platform FROM summary_runs").fetchone()[0]
+    assert got == "tpu"
+    conn.close()
