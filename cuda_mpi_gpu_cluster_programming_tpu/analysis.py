@@ -78,11 +78,16 @@ class _Stdev:
 
 
 def _backfill_platform(conn: sqlite3.Connection) -> None:
-    """One-time migration companion for the platform column: derive it for
-    rows ingested before the column existed. The sha1-incremental ingest
-    never revisits unchanged CSVs, so without this an upgraded warehouse
-    would keep pooling its old CPU and TPU rows in one NULL-platform group
-    — the exact conflation the column exists to fix."""
+    """Derive platform for rows that predate the column. The
+    sha1-incremental ingest never revisits unchanged CSVs, so without this
+    an upgraded warehouse would keep pooling its old CPU and TPU rows in
+    one NULL-platform group — the exact conflation the column exists to
+    fix. Runs on EVERY connect, not just the migration: it is idempotent
+    (only NULL rows are touched, so the steady-state query is cheap) and a
+    one-shot attempt could fail silently-forever when the log paths don't
+    resolve from the current cwd (src_csv is stored as ingested, often
+    relative) — retrying each connect picks those rows up the next time
+    the warehouse is opened from the right directory."""
     rows = conn.execute(
         "SELECT rowid, src_csv, log_file, corpus FROM summary_runs "
         "WHERE platform IS NULL"
@@ -142,7 +147,6 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
         conn.execute("ALTER TABLE summary_runs ADD COLUMN corpus TEXT")
     if "platform" not in cols:
         conn.execute("ALTER TABLE summary_runs ADD COLUMN platform TEXT")
-        _backfill_platform(conn)
     conn.executescript(
         """
         DROP VIEW IF EXISTS perf_runs;
@@ -176,6 +180,7 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
             FROM perf_runs GROUP BY corpus, platform, variant, np, batch;
         """
     )
+    _backfill_platform(conn)
     return conn
 
 

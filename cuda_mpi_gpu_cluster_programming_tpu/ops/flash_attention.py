@@ -375,3 +375,32 @@ def _flash_diff_bwd(causal, block_q, block_k, res, g):
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> tuple:
+    """Forward-only fused attention returning ``(out, lse)``.
+
+    ``out``: (B, L, H, D) normalized attention; ``lse``: (B, H, L) per-row
+    log-sum-exp of the scaled scores. Two normalized partials over disjoint
+    key sets merge exactly via their LSEs::
+
+        lse  = logaddexp(lse1, lse2)
+        out  = exp(lse1 - lse) * out1 + exp(lse2 - lse) * out2
+
+    which is what the ring-attention flash engine does per hop
+    (parallel.sequence_parallel). NOT differentiable — the custom VJP only
+    covers :func:`flash_attention`'s out-only signature; the training path
+    keeps the einsum engine.
+    """
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, return_lse=True
+    )
+    return out, lse[:, :, 0, :]  # (B, H, 1, L) internal layout -> (B, H, L)

@@ -77,12 +77,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
 
     # The zero/neg-inf initials are shard-invariant, but the loop carries
     # shard-varying updates — fori_loop needs both sides typed alike.
-    # lax.pcast(..., to='varying') is the current spelling; pvary is the
-    # deprecated alias kept as a fallback for older JAX builds.
-    if hasattr(lax, "pcast"):
-        _to_varying = lambda a: lax.pcast(a, axis_name, to="varying")  # noqa: E731
-    else:  # pragma: no cover — pre-pcast JAX
-        _to_varying = lambda a: lax.pvary(a, axis_name)  # noqa
+    _to_varying = _to_varying_fn(axis_name)
     m0 = _to_varying(jnp.full((b, h, lb), NEG_INF, jnp.float32))
     num0 = _to_varying(jnp.zeros((b, h, lb, d), jnp.float32))
     den0 = _to_varying(jnp.zeros((b, h, lb), jnp.float32))
@@ -96,6 +91,75 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _to_varying_fn(axis_name: str):
+    # lax.pcast(..., to='varying') is the current spelling; pvary is the
+    # deprecated alias kept as a fallback for older JAX builds.
+    if hasattr(lax, "pcast"):
+        return lambda a: lax.pcast(a, axis_name, to="varying")
+    return lambda a: lax.pvary(a, axis_name)  # noqa — pre-pcast JAX fallback
+
+
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causal: bool):
+    """Flash-engine ring body: each hop runs the Pallas flash kernel on the
+    resident K/V block and merges the normalized partial via its per-row
+    LSE — exact, because partials over disjoint key sets satisfy
+
+        lse  = logaddexp(lse1, lse2)
+        out  = exp(lse1 - lse)*out1 + exp(lse2 - lse)*out2.
+
+    Removes the einsum engine's (B, H, Lb, Lb) score residency: memory is
+    O(Lb·D) per chip on top of the ring's O(L/n) — the two-level long-
+    context composition (ring across chips × flash within chip). Causal
+    hops split three ways on the block's global position: src < me = full
+    attention, src == me = in-block causal, src > me = skipped (the flash
+    kernel's causal mask is block-local, so the split is done here).
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    b, lb, h, d = q.shape
+    me = lax.axis_index(axis_name)
+
+    def full_fn(q, kb, vb):
+        o, s = flash_attention_with_lse(q, kb, vb, causal=False)
+        return o.astype(jnp.float32), s
+
+    def causal_fn(q, kb, vb):
+        o, s = flash_attention_with_lse(q, kb, vb, causal=True)
+        return o.astype(jnp.float32), s
+
+    def skip_fn(q, kb, vb):
+        return (
+            jnp.zeros((b, lb, h, d), jnp.float32),
+            jnp.full((b, h, lb), NEG_INF, jnp.float32),
+        )
+
+    def step(t, carry):
+        k_blk, v_blk, out, lse = carry
+        src = (me - t) % n_shards
+        if causal:
+            idx = jnp.where(src < me, 0, jnp.where(src == me, 1, 2))
+            o_t, lse_t = lax.switch(idx, [full_fn, causal_fn, skip_fn], q, k_blk, v_blk)
+        else:
+            o_t, lse_t = full_fn(q, k_blk, v_blk)
+        lse_new = jnp.logaddexp(lse, lse_t)  # (B, H, Lb)
+        w_old = jnp.exp(lse - lse_new)
+        w_t = jnp.exp(lse_t - lse_new)
+        out = (
+            out * jnp.transpose(w_old, (0, 2, 1))[..., None]
+            + o_t * jnp.transpose(w_t, (0, 2, 1))[..., None]
+        )
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, out, lse_new
+
+    tv = _to_varying_fn(axis_name)
+    out0 = tv(jnp.zeros((b, lb, h, d), jnp.float32))
+    lse0 = tv(jnp.full((b, h, lb), NEG_INF, jnp.float32))
+    _, _, out, _ = lax.fori_loop(0, n_shards, step, (k, v, out0, lse0))
+    return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -105,24 +169,53 @@ def ring_attention(
     causal: bool = False,
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
+    engine: str = "einsum",
 ) -> jax.Array:
     """Sequence-sharded blockwise ring attention. q,k,v: (B, L, H, D).
 
     The sequence axis is sharded ``n_shards`` ways; K/V blocks ride the ring
     via ``ppermute`` (ICI neighbor traffic, the same collective as the conv
     halo exchange). Requires ``L % n_shards == 0``.
+
+    ``engine``: ``"einsum"`` (default) materializes each hop's (Lb, Lb)
+    score block with XLA ops — differentiable, the training path.
+    ``"flash"`` runs the Pallas flash kernel per hop and merges partials by
+    LSE — O(Lb·D) within-chip memory for long per-chip blocks, forward
+    only (the flash VJP covers the whole-sequence call, not the per-hop
+    LSE-merged composition).
     """
     b, l, h, d = q.shape
     if l % n_shards != 0:
         raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
+    if engine not in ("einsum", "flash"):
+        raise ValueError(f"engine must be einsum|flash, got {engine!r}")
+    if engine == "flash":
+        # The flash kernel tiles each shard's block at (up to) 128 rows, so
+        # the PER-SHARD length must divide by its clamped block size —
+        # validate here with global numbers, or the error would surface
+        # from inside the shard_map trace quoting the shard-local length.
+        lb = l // n_shards
+        blk = min(128, lb)
+        if lb % blk:
+            raise ValueError(
+                f"engine='flash' needs the per-shard block (L/n = {lb}) to be "
+                f"a multiple of the flash block size ({blk}); L={l}, "
+                f"n_shards={n_shards}. Use the einsum engine or pad L."
+            )
     if mesh is None:
         mesh = make_mesh(n_shards, axis_name=axis_name)
+    local = _ring_attention_local_flash if engine == "flash" else _ring_attention_local
     body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, n_shards=n_shards, causal=causal
+        local, axis_name=axis_name, n_shards=n_shards, causal=causal
     )
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call out_shapes carry no varying-mesh-axes (vma) metadata,
+        # so the vma checker rejects the flash engine inside shard_map
+        # (same workaround as the sharded conv tier, parallel/sharded.py);
+        # the einsum engine keeps the checker.
+        check_vma=(engine != "flash"),
     )
     return fn(q, k, v)
 
