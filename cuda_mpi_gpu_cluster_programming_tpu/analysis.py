@@ -111,6 +111,10 @@ def _backfill_platform(conn: sqlite3.Connection) -> None:
             )
             n += 1
     if n:
+        # Persist explicitly: read-only subcommands (stats/speedup/plot/
+        # export/report) never call conn.commit(), so without this the
+        # UPDATEs roll back on close and the backfill re-runs forever.
+        conn.commit()
         print(f"backfilled platform for {n} pre-migration rows", file=sys.stderr)
 
 

@@ -45,6 +45,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--repeats", type=int, default=10)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument(
+        "--engine",
+        choices=["einsum", "flash"],
+        default="einsum",
+        help="within-shard engine for ring/ulysses: einsum = XLA score "
+        "blocks (differentiable); flash = Pallas flash kernel per "
+        "hop/shard (O(block) memory; ring's flash engine is forward-only)",
+    )
+    p.add_argument(
         "--verify",
         action="store_true",
         help="also run the single-device oracle and report max |delta| "
@@ -86,13 +94,15 @@ def main(argv=None) -> int:
     elif args.strategy == "ring":
         fn = jax.jit(
             lambda q, k, v: ring_attention(
-                q, k, v, n_shards=args.shards, causal=args.causal
+                q, k, v, n_shards=args.shards, causal=args.causal,
+                engine=args.engine,
             )
         )
     else:
         fn = jax.jit(
             lambda q, k, v: ulysses_attention(
-                q, k, v, n_shards=args.shards, causal=args.causal
+                q, k, v, n_shards=args.shards, causal=args.causal,
+                engine=args.engine,
             )
         )
 

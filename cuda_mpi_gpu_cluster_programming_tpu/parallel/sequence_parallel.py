@@ -220,9 +220,19 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
-    """Per-shard body: all_to_all L-shard -> H-shard, exact attention, back."""
-    from ..ops.attention import attention
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str):
+    """Per-shard body: all_to_all L-shard -> H-shard, exact attention, back.
+
+    After the reshard each shard holds the FULL sequence for its local
+    heads, so ``engine='flash'`` is just :func:`ops.flash_attention` on
+    that call — the whole-sequence signature its custom VJP covers, hence
+    (unlike the ring's per-hop LSE merge) it remains differentiable while
+    dropping the (L, L) score residency of the einsum path.
+    """
+    if engine == "flash":
+        from ..ops.flash_attention import flash_attention as attention
+    else:
+        from ..ops.attention import attention
 
     # (B, Lb, H, D) -> (B, L, Hb, D): concat sequence, split heads.
     def to_heads(x):
@@ -244,6 +254,7 @@ def ulysses_attention(
     causal: bool = False,
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
+    engine: str = "einsum",
 ) -> jax.Array:
     """All-to-all (Ulysses-style) sequence parallelism. q,k,v: (B, L, H, D).
 
@@ -251,17 +262,36 @@ def ulysses_attention(
     the full sequence for ``H/n`` heads; two tiled ``all_to_all`` collectives
     replace the ring's n ppermute hops. Requires ``L % n == 0`` and
     ``H % n == 0``.
+
+    ``engine='flash'`` swaps the local exact attention for the Pallas flash
+    kernel — O(L) instead of O(L^2) memory per shard, and still
+    differentiable (the local call is the whole-sequence signature the
+    flash custom VJP covers). Requires ``L`` to divide by the flash block
+    (128 when ``L >= 128``).
     """
     b, l, h, d = q.shape
     if l % n_shards != 0:
         raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
     if h % n_shards != 0:
         raise ValueError(f"head count {h} not divisible by {n_shards} shards")
+    if engine not in ("einsum", "flash"):
+        raise ValueError(f"engine must be einsum|flash, got {engine!r}")
+    if engine == "flash":
+        blk = min(128, l)
+        if l % blk:
+            raise ValueError(
+                f"engine='flash' needs L ({l}) to be a multiple of the flash "
+                f"block size ({blk}). Use the einsum engine or pad L."
+            )
     if mesh is None:
         mesh = make_mesh(n_shards, axis_name=axis_name)
-    body = functools.partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    body = functools.partial(
+        _ulysses_local, axis_name=axis_name, causal=causal, engine=engine
+    )
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # same vma workaround as the ring flash engine / sharded conv tier
+        check_vma=(engine != "flash"),
     )
     return fn(q, k, v)

@@ -320,3 +320,10 @@ def test_platform_backfill_on_legacy_warehouse(tmp_path):
     got = conn.execute("SELECT platform FROM summary_runs").fetchone()[0]
     assert got == "tpu"
     conn.close()
+    # The backfill must COMMIT: read-only subcommands close without
+    # committing, which would roll the UPDATEs back (regression test for
+    # the round-3 review finding — value was 'tpu' in-connection but NULL
+    # after close).
+    conn = analysis.connect(db)
+    assert conn.execute("SELECT platform FROM summary_runs").fetchone()[0] == "tpu"
+    conn.close()
