@@ -495,11 +495,33 @@ def lrn_pallas(
 def relu_pallas(x: jax.Array) -> jax.Array:
     """Standalone elementwise ReLU kernel (reference: reluKernel,
     layers_cuda.cu:66-75). The conv kernel fuses ReLU, so this exists for
-    parity/benchmarking of the unfused launch sequence."""
+    parity/benchmarking of the unfused launch sequence.
+
+    Gridded over the leading axis for ndim >= 3: a gridless whole-array
+    VMEM mapping would exceed the ~16 MB scoped-VMEM limit for any real
+    batch of activations (e.g. conv1 at b >= 32). ndim <= 2 stays
+    gridless — a (1, M) block over a 2-D array would put 1 in the
+    sublane dim, which Mosaic's last-two-dims tiling rule rejects (the
+    same constraint as the flash LSE layout), and 2-D inputs here are
+    small parity-test vectors."""
 
     def kernel(x_ref, o_ref):
         o_ref[:] = jnp.maximum(x_ref[:], 0.0).astype(o_ref.dtype)
 
+    if x.ndim >= 3:
+        n = x.shape[0]
+        rest = x.shape[1:]
+        block = (1, *rest)
+        idx = lambda i: (i,) + (0,) * len(rest)  # noqa: E731
+        return pl.pallas_call(
+            kernel,
+            grid=(n,),
+            in_specs=[_vmem_spec(block, idx)],
+            out_specs=_vmem_spec(block, idx),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            compiler_params=_tc_params("parallel"),
+            interpret=_interpret(),
+        )(x)
     return pl.pallas_call(
         kernel,
         in_specs=[_vmem_spec()],
