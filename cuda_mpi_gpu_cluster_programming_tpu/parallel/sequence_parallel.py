@@ -41,7 +41,7 @@ def _block_scores(q, k, scale):
     ) * scale
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: bool):
+def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: bool, vary_axes=None):
     """Per-shard body: online-softmax over ring-circulating K/V blocks.
 
     q/k/v: this shard's (B, Lb, H, D) block. At step t the resident K/V
@@ -77,7 +77,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
 
     # The zero/neg-inf initials are shard-invariant, but the loop carries
     # shard-varying updates — fori_loop needs both sides typed alike.
-    _to_varying = _to_varying_fn(axis_name)
+    _to_varying = _to_varying_fn(vary_axes or (axis_name,))
     m0 = _to_varying(jnp.full((b, h, lb), NEG_INF, jnp.float32))
     num0 = _to_varying(jnp.zeros((b, h, lb, d), jnp.float32))
     den0 = _to_varying(jnp.zeros((b, h, lb), jnp.float32))
@@ -91,15 +91,19 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def _to_varying_fn(axis_name: str):
+def _to_varying_fn(axes):
     # lax.pcast(..., to='varying') is the current spelling; pvary is the
-    # deprecated alias kept as a fallback for older JAX builds.
+    # deprecated alias kept as a fallback for older JAX builds. ``axes``:
+    # every mesh axis the loop carry varies over — with a head_axis (sp x
+    # tp composition) the K/V inputs vary over BOTH, and fori_loop demands
+    # carry-in/carry-out type equality.
+    axes = tuple(axes)
     if hasattr(lax, "pcast"):
-        return lambda a: lax.pcast(a, axis_name, to="varying")
-    return lambda a: lax.pvary(a, axis_name)  # noqa — pre-pcast JAX fallback
+        return lambda a: lax.pcast(a, axes, to="varying")
+    return lambda a: lax.pvary(a, axes)  # noqa — pre-pcast JAX fallback
 
 
-def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causal: bool):
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causal: bool, vary_axes=None):
     """Flash-engine ring body: each hop runs the Pallas flash kernel on the
     resident K/V block and merges the normalized partial via its per-row
     LSE — exact, because partials over disjoint key sets satisfy
@@ -153,7 +157,7 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, out, lse_new
 
-    tv = _to_varying_fn(axis_name)
+    tv = _to_varying_fn(vary_axes or (axis_name,))
     out0 = tv(jnp.zeros((b, lb, h, d), jnp.float32))
     lse0 = tv(jnp.full((b, h, lb), NEG_INF, jnp.float32))
     _, _, out, _ = lax.fori_loop(0, n_shards, step, (k, v, out0, lse0))
@@ -170,12 +174,19 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
     engine: str = "einsum",
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """Sequence-sharded blockwise ring attention. q,k,v: (B, L, H, D).
 
     The sequence axis is sharded ``n_shards`` ways; K/V blocks ride the ring
     via ``ppermute`` (ICI neighbor traffic, the same collective as the conv
     halo exchange). Requires ``L % n_shards == 0``.
+
+    ``head_axis``: optional second mesh axis sharding H — the sp×tp
+    composition (Megatron attention heads over ``tp``, sequence over
+    ``sp``). Heads are embarrassingly parallel in attention, so the ring
+    body is unchanged; only the shard_map spec names the extra axis. The
+    caller's ``mesh`` must contain both axes.
 
     ``engine``: ``"einsum"`` (default) materializes each hop's (Lb, Lb)
     score block with XLA ops — differentiable, the training path.
@@ -202,13 +213,30 @@ def ring_attention(
                 f"a multiple of the flash block size ({blk}); L={l}, "
                 f"n_shards={n_shards}. Use the einsum engine or pad L."
             )
+    if head_axis is not None and mesh is None:
+        raise ValueError("head_axis needs an explicit mesh containing both axes")
+    if head_axis is not None:
+        # Pre-validate with global numbers, matching this function's other
+        # constraints — otherwise the mismatch surfaces as a raw shard_map
+        # partitioning error quoting shard-local shapes.
+        if head_axis not in mesh.shape:
+            raise ValueError(
+                f"head_axis {head_axis!r} not in mesh axes {tuple(mesh.shape)}"
+            )
+        tp = mesh.shape[head_axis]
+        if h % tp:
+            raise ValueError(
+                f"head count {h} not divisible by {head_axis}={tp} shards"
+            )
     if mesh is None:
         mesh = make_mesh(n_shards, axis_name=axis_name)
     local = _ring_attention_local_flash if engine == "flash" else _ring_attention_local
+    vary = (axis_name,) + ((head_axis,) if head_axis else ())
     body = functools.partial(
-        local, axis_name=axis_name, n_shards=n_shards, causal=causal
+        local, axis_name=axis_name, n_shards=n_shards, causal=causal,
+        vary_axes=vary,
     )
-    spec = P(None, axis_name, None, None)
+    spec = P(None, axis_name, head_axis, None)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         # pallas_call out_shapes carry no varying-mesh-axes (vma) metadata,

@@ -165,3 +165,42 @@ class TestUlyssesFlashEngine:
         q, k, v = qkv(jax.random.PRNGKey(34))
         with pytest.raises(ValueError, match="engine"):
             ulysses_attention(q, k, v, n_shards=4, engine="warp")
+
+
+class TestRingTpComposition:
+    """sp x tp: sequence ring-sharded, attention heads tensor-sharded —
+    the Megatron long-context combination. Heads are embarrassingly
+    parallel in attention, so sharding H over a second mesh axis must not
+    change numerics for either engine."""
+
+    @pytest.mark.parametrize("engine", ["einsum", "flash"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, engine, causal):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("sp", "tp"))
+        q, k, v = qkv(jax.random.PRNGKey(41), l=128, h=8)
+        want = attention(q, k, v, causal=causal)
+        got = ring_attention(
+            q, k, v, n_shards=4, causal=causal, mesh=mesh,
+            engine=engine, head_axis="tp",
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    def test_head_axis_requires_mesh(self):
+        q, k, v = qkv(jax.random.PRNGKey(42))
+        with pytest.raises(ValueError, match="mesh"):
+            ring_attention(q, k, v, n_shards=4, head_axis="tp")
+
+    def test_head_divisibility_and_axis_validated(self):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("sp", "tp"))
+        q, k, v = qkv(jax.random.PRNGKey(43), l=128, h=5)  # 5 % 2 != 0
+        with pytest.raises(ValueError, match="head count"):
+            ring_attention(q, k, v, n_shards=4, mesh=mesh, head_axis="tp")
+        q, k, v = qkv(jax.random.PRNGKey(44), l=128, h=8)
+        with pytest.raises(ValueError, match="not in mesh"):
+            ring_attention(q, k, v, n_shards=4, mesh=mesh, head_axis="ep")
