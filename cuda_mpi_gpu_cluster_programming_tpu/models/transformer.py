@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +46,10 @@ class TransformerConfig:
     max_len: int = 1024
     attn_impl: str = "reference"  # reference | flash | ring | ulysses
     sp_shards: int = 1  # ring/ulysses mesh size
+    # sp x tp composition: name of the mesh axis the attention heads are
+    # tensor-sharded over (shard_lm_params_tp's axis); ring/ulysses then
+    # name it in their shard_map specs so CP and TP compose in one step.
+    sp_head_axis: Optional[str] = None
     # Mixture-of-experts FFN (0 = dense). Top-1 (Switch) routing with a
     # capacity limit; the expert axis is what EP shards (see moe_ffn).
     n_experts: int = 0
@@ -117,11 +121,17 @@ def _attend(q, k, v, cfg: TransformerConfig, mesh=None):
     if cfg.attn_impl == "ring":
         from ..parallel.sequence_parallel import ring_attention
 
-        return ring_attention(q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh)
+        return ring_attention(
+            q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh,
+            head_axis=cfg.sp_head_axis,
+        )
     if cfg.attn_impl == "ulysses":
         from ..parallel.sequence_parallel import ulysses_attention
 
-        return ulysses_attention(q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh)
+        return ulysses_attention(
+            q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh,
+            head_axis=cfg.sp_head_axis,
+        )
     raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 
 

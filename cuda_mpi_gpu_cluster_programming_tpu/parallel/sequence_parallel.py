@@ -164,6 +164,34 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
     return out.astype(q.dtype)
 
 
+def _validate_ring_mesh(mesh, axis_name: str, n_shards: int) -> None:
+    """n_shards must equal the mesh's axis size: the fori_loop runs
+    n_shards hops and the ppermute permutation has n_shards entries, so a
+    mismatch silently computes attention over a subset of the K/V blocks
+    (verified: max abs error ~0.8 vs the oracle) rather than erroring."""
+    if mesh is not None and dict(mesh.shape).get(axis_name) != n_shards:
+        raise ValueError(
+            f"n_shards ({n_shards}) != mesh axis {axis_name!r} size "
+            f"({dict(mesh.shape).get(axis_name)}); the ring/reshard hop "
+            "count is n_shards"
+        )
+
+
+def _validate_head_axis(mesh, head_axis: str, h: int, divisor: int, what: str) -> None:
+    """Shared sp x tp pre-validation (ring and ulysses differ only in the
+    head divisor): explicit mesh, axis present, heads divisible — all with
+    global numbers, so failures never surface as raw shard_map errors
+    quoting shard-local shapes."""
+    if mesh is None:
+        raise ValueError("head_axis needs an explicit mesh containing both axes")
+    if head_axis not in mesh.shape:
+        raise ValueError(
+            f"head_axis {head_axis!r} not in mesh axes {tuple(mesh.shape)}"
+        )
+    if h % divisor:
+        raise ValueError(f"head count {h} not divisible by {what}")
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -213,21 +241,10 @@ def ring_attention(
                 f"a multiple of the flash block size ({blk}); L={l}, "
                 f"n_shards={n_shards}. Use the einsum engine or pad L."
             )
-    if head_axis is not None and mesh is None:
-        raise ValueError("head_axis needs an explicit mesh containing both axes")
+    _validate_ring_mesh(mesh, axis_name, n_shards)
     if head_axis is not None:
-        # Pre-validate with global numbers, matching this function's other
-        # constraints — otherwise the mismatch surfaces as a raw shard_map
-        # partitioning error quoting shard-local shapes.
-        if head_axis not in mesh.shape:
-            raise ValueError(
-                f"head_axis {head_axis!r} not in mesh axes {tuple(mesh.shape)}"
-            )
-        tp = mesh.shape[head_axis]
-        if h % tp:
-            raise ValueError(
-                f"head count {h} not divisible by {head_axis}={tp} shards"
-            )
+        tp = dict(mesh.shape).get(head_axis, 1) if mesh else 1
+        _validate_head_axis(mesh, head_axis, h, tp, f"{head_axis} shards")
     if mesh is None:
         mesh = make_mesh(n_shards, axis_name=axis_name)
     local = _ring_attention_local_flash if engine == "flash" else _ring_attention_local
@@ -248,7 +265,7 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str):  # noqa: D401
     """Per-shard body: all_to_all L-shard -> H-shard, exact attention, back.
 
     After the reshard each shard holds the FULL sequence for its local
@@ -283,6 +300,7 @@ def ulysses_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
     engine: str = "einsum",
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """All-to-all (Ulysses-style) sequence parallelism. q,k,v: (B, L, H, D).
 
@@ -302,6 +320,15 @@ def ulysses_attention(
         raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
     if h % n_shards != 0:
         raise ValueError(f"head count {h} not divisible by {n_shards} shards")
+    _validate_ring_mesh(mesh, axis_name, n_shards)
+    if head_axis is not None:
+        # sp x tp: heads are pre-sharded over tp; the all_to_all then splits
+        # each tp shard's local heads over sp, so H must divide by BOTH.
+        tp = dict(mesh.shape).get(head_axis, 0) if mesh else 0
+        _validate_head_axis(
+            mesh, head_axis, h, n_shards * tp if tp else 1,
+            f"sp x {head_axis} = {n_shards} x {tp} shards",
+        )
     if engine not in ("einsum", "flash"):
         raise ValueError(f"engine must be einsum|flash, got {engine!r}")
     if engine == "flash":
@@ -316,7 +343,7 @@ def ulysses_attention(
     body = functools.partial(
         _ulysses_local, axis_name=axis_name, causal=causal, engine=engine
     )
-    spec = P(None, axis_name, None, None)
+    spec = P(None, axis_name, head_axis, None)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         # same vma workaround as the ring flash engine / sharded conv tier
