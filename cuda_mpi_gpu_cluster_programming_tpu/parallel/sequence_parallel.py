@@ -164,11 +164,12 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
     return out.astype(q.dtype)
 
 
-def _validate_ring_mesh(mesh, axis_name: str, n_shards: int) -> None:
-    """n_shards must equal the mesh's axis size: the fori_loop runs
+def _validate_mesh_axis_size(mesh, axis_name: str, n_shards: int) -> None:
+    """n_shards must equal the mesh's axis size. Ring: the fori_loop runs
     n_shards hops and the ppermute permutation has n_shards entries, so a
     mismatch silently computes attention over a subset of the K/V blocks
-    (verified: max abs error ~0.8 vs the oracle) rather than erroring."""
+    (verified: max abs error ~0.8 vs the oracle). Ulysses: the L/H split
+    arithmetic assumes the all_to_all group size equals n_shards."""
     if mesh is not None and dict(mesh.shape).get(axis_name) != n_shards:
         raise ValueError(
             f"n_shards ({n_shards}) != mesh axis {axis_name!r} size "
@@ -177,19 +178,18 @@ def _validate_ring_mesh(mesh, axis_name: str, n_shards: int) -> None:
         )
 
 
-def _validate_head_axis(mesh, head_axis: str, h: int, divisor: int, what: str) -> None:
-    """Shared sp x tp pre-validation (ring and ulysses differ only in the
-    head divisor): explicit mesh, axis present, heads divisible — all with
-    global numbers, so failures never surface as raw shard_map errors
-    quoting shard-local shapes."""
+def _validate_head_axis_mesh(mesh, head_axis: str) -> int:
+    """Shared sp x tp pre-validation: explicit mesh, axis present. Returns
+    the head-axis size so each caller applies its own divisibility rule
+    (ring: tp; ulysses: sp*tp) — all with global numbers, so failures
+    never surface as raw shard_map errors quoting shard-local shapes."""
     if mesh is None:
         raise ValueError("head_axis needs an explicit mesh containing both axes")
     if head_axis not in mesh.shape:
         raise ValueError(
             f"head_axis {head_axis!r} not in mesh axes {tuple(mesh.shape)}"
         )
-    if h % divisor:
-        raise ValueError(f"head count {h} not divisible by {what}")
+    return dict(mesh.shape)[head_axis]
 
 
 def ring_attention(
@@ -241,10 +241,11 @@ def ring_attention(
                 f"a multiple of the flash block size ({blk}); L={l}, "
                 f"n_shards={n_shards}. Use the einsum engine or pad L."
             )
-    _validate_ring_mesh(mesh, axis_name, n_shards)
+    _validate_mesh_axis_size(mesh, axis_name, n_shards)
     if head_axis is not None:
-        tp = dict(mesh.shape).get(head_axis, 1) if mesh else 1
-        _validate_head_axis(mesh, head_axis, h, tp, f"{head_axis} shards")
+        tp = _validate_head_axis_mesh(mesh, head_axis)
+        if h % tp:
+            raise ValueError(f"head count {h} not divisible by {head_axis}={tp} shards")
     if mesh is None:
         mesh = make_mesh(n_shards, axis_name=axis_name)
     local = _ring_attention_local_flash if engine == "flash" else _ring_attention_local
@@ -320,15 +321,16 @@ def ulysses_attention(
         raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
     if h % n_shards != 0:
         raise ValueError(f"head count {h} not divisible by {n_shards} shards")
-    _validate_ring_mesh(mesh, axis_name, n_shards)
+    _validate_mesh_axis_size(mesh, axis_name, n_shards)
     if head_axis is not None:
         # sp x tp: heads are pre-sharded over tp; the all_to_all then splits
         # each tp shard's local heads over sp, so H must divide by BOTH.
-        tp = dict(mesh.shape).get(head_axis, 0) if mesh else 0
-        _validate_head_axis(
-            mesh, head_axis, h, n_shards * tp if tp else 1,
-            f"sp x {head_axis} = {n_shards} x {tp} shards",
-        )
+        tp = _validate_head_axis_mesh(mesh, head_axis)
+        if h % (n_shards * tp):
+            raise ValueError(
+                f"head count {h} not divisible by sp x {head_axis} = "
+                f"{n_shards} x {tp} shards"
+            )
     if engine not in ("einsum", "flash"):
         raise ValueError(f"engine must be einsum|flash, got {engine!r}")
     if engine == "flash":
