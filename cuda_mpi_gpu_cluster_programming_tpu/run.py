@@ -214,8 +214,10 @@ def main(argv=None) -> int:
     if args.breakdown:
         from .utils.profiling import layer_breakdown
 
-        # Per-layer costs of the XLA-op tier (the per-phase breakdown the
-        # reference lists as future work, reference README.md:233).
+        # Per-layer costs (the per-phase breakdown the reference lists as
+        # future work, reference README.md:233) — timed on the SELECTED
+        # config's op tier, so a v3_pallas breakdown attributes cost to
+        # the hand-written kernels, not the XLA ops.
         for name, ms, shape in layer_breakdown(
             params,
             x,
@@ -223,6 +225,7 @@ def main(argv=None) -> int:
             repeats=max(1, args.repeats),
             warmup=n_small,
             compute=args.compute,
+            tier=exec_cfg.tier,
         ):
             shape_s = "x".join(str(d) for d in shape[1:])
             print(f"Layer {name} completed in {ms:.3f} ms -> {shape_s}")

@@ -18,6 +18,7 @@ This module ships them:
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Callable, List, Tuple
 
 import jax
@@ -25,16 +26,6 @@ import jax
 from ..models.alexnet import BLOCKS12, ConvSpec, LrnSpec, Params, PoolSpec
 from ..ops import reference as ops
 from .timing import amortized_ms
-
-
-def _conv_stage(name: str, spec: ConvSpec, fuse_relu: bool):
-    def fn(p, x):
-        out = ops.conv2d(
-            x, p[name]["w"], p[name]["b"], stride=spec.stride, padding=spec.padding
-        )
-        return ops.relu(out) if fuse_relu else out
-
-    return fn
 
 
 def _fc_stage(name: str, relu_after: bool):
@@ -48,6 +39,7 @@ def _fc_stage(name: str, relu_after: bool):
 
 def stage_fns(
     cfg=BLOCKS12,
+    tier: str = "reference",
 ) -> List[Tuple[str, Callable[[Params, jax.Array], jax.Array]]]:
     """(name, fn) per layer; each fn maps that layer's input to its output.
 
@@ -55,35 +47,90 @@ def stage_fns(
     reference's 7-layer print chain) or an ``AlexNetConfig`` (relu fused
     into each conv stage as in ``alexnet_full.forward_spatial``, plus the
     FC6-8 head stages).
+
+    ``tier='pallas'`` times the hand-written kernels instead of the
+    XLA-op tier — the per-layer attribution that located the pool
+    bottleneck in round 3 required measuring the Pallas ops directly
+    (docs/PALLAS_PERF.md); conv stages fuse ReLU (the kernel's epilogue),
+    so the chain has 5 stages, matching forward_blocks12_pallas.
     """
+    conv, pool, lrn, fused_relu = _tier_ops(tier)
     full = hasattr(cfg, "blocks12")  # AlexNetConfig
     stages: List[Tuple[str, Callable]] = []
     if full:
         for name, spec in cfg.layer_chain():
             if isinstance(spec, ConvSpec):
-                stages.append((name, _conv_stage(name, spec, fuse_relu=True)))
+                stages.append((name, functools.partial(conv, name=name, spec=spec, relu=True)))
             elif isinstance(spec, PoolSpec):
-                stages.append((name, lambda p, x, s=spec: ops.maxpool(x, window=s.window, stride=s.stride)))
+                stages.append((name, functools.partial(pool, spec=spec)))
             elif isinstance(spec, LrnSpec):
-                stages.append((name, lambda p, x, s=spec: ops.lrn(
-                    x, size=s.size, alpha=s.alpha, beta=s.beta, k=s.k,
-                    alpha_over_size=s.alpha_over_size)))
+                stages.append((name, functools.partial(lrn, spec=spec)))
         stages.append(("fc6", _fc_stage("fc6", relu_after=True)))
         stages.append(("fc7", _fc_stage("fc7", relu_after=True)))
         stages.append(("fc8", _fc_stage("fc8", relu_after=False)))
         return stages
     c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
+    if fused_relu:  # pallas: relu lives in the conv kernel epilogue
+        return [
+            ("conv1+relu", functools.partial(conv, name="conv1", spec=c1, relu=True)),
+            ("pool1", functools.partial(pool, spec=p1)),
+            ("conv2+relu", functools.partial(conv, name="conv2", spec=c2, relu=True)),
+            ("pool2", functools.partial(pool, spec=p2)),
+            ("lrn2", functools.partial(lrn, spec=n2)),
+        ]
     return [
-        ("conv1", lambda p, x: ops.conv2d(x, p["conv1"]["w"], p["conv1"]["b"], stride=c1.stride, padding=c1.padding)),
+        ("conv1", functools.partial(conv, name="conv1", spec=c1, relu=False)),
         ("relu1", lambda p, x: ops.relu(x)),
-        ("pool1", lambda p, x: ops.maxpool(x, window=p1.window, stride=p1.stride)),
-        ("conv2", lambda p, x: ops.conv2d(x, p["conv2"]["w"], p["conv2"]["b"], stride=c2.stride, padding=c2.padding)),
+        ("pool1", functools.partial(pool, spec=p1)),
+        ("conv2", functools.partial(conv, name="conv2", spec=c2, relu=False)),
         ("relu2", lambda p, x: ops.relu(x)),
-        ("pool2", lambda p, x: ops.maxpool(x, window=p2.window, stride=p2.stride)),
-        ("lrn2", lambda p, x: ops.lrn(
-            x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k,
-            alpha_over_size=n2.alpha_over_size)),
+        ("pool2", functools.partial(pool, spec=p2)),
+        ("lrn2", functools.partial(lrn, spec=n2)),
     ]
+
+
+def _tier_ops(tier: str):
+    """(conv, pool, lrn, fused_relu) stage ops for one tier — ONE chain
+    walk in stage_fns serves both tiers (they previously diverged as two
+    near-identical walks). Each op takes (params, x, *, ...spec kwargs).
+    """
+    if tier == "reference":
+        def conv(p, x, *, name, spec, relu):
+            out = ops.conv2d(
+                x, p[name]["w"], p[name]["b"], stride=spec.stride, padding=spec.padding
+            )
+            return ops.relu(out) if relu else out
+
+        def pool(p, x, *, spec):
+            return ops.maxpool(x, window=spec.window, stride=spec.stride)
+
+        def lrn(p, x, *, spec):
+            return ops.lrn(
+                x, size=spec.size, alpha=spec.alpha, beta=spec.beta, k=spec.k,
+                alpha_over_size=spec.alpha_over_size,
+            )
+
+        return conv, pool, lrn, False
+    if tier == "pallas":
+        from ..ops import pallas_kernels as pk
+
+        def conv(p, x, *, name, spec, relu):
+            return pk.conv2d_pallas(
+                x, p[name]["w"], p[name]["b"], stride=spec.stride,
+                padding=spec.padding, relu=relu,
+            )
+
+        def pool(p, x, *, spec):
+            return pk.maxpool_pallas(x, window=spec.window, stride=spec.stride)
+
+        def lrn(p, x, *, spec):
+            return pk.lrn_pallas(
+                x, size=spec.size, alpha=spec.alpha, beta=spec.beta, k=spec.k,
+                alpha_over_size=spec.alpha_over_size,
+            )
+
+        return conv, pool, lrn, True
+    raise ValueError(f"tier must be reference|pallas, got {tier!r}")
 
 
 def forward_annotated(params: Params, x: jax.Array, cfg=BLOCKS12) -> jax.Array:
@@ -101,6 +148,7 @@ def layer_breakdown(
     repeats: int = 10,
     warmup: int = 3,
     compute: str = "fp32",
+    tier: str = "reference",
 ) -> List[Tuple[str, float, Tuple[int, ...]]]:
     """Fenced per-layer timing: [(layer, ms, output_shape), ...].
 
@@ -119,7 +167,7 @@ def layer_breakdown(
         raise ValueError(f"unknown compute mode {compute!r} (fp32|bf16)")
     rows: List[Tuple[str, float, Tuple[int, ...]]] = []
     cur = x
-    for name, fn in stage_fns(cfg):
+    for name, fn in stage_fns(cfg, tier=tier):
         jfn = jax.jit(fn)
         ms = amortized_ms(jfn, params, cur, n_small=max(1, warmup), n_large=max(1, warmup) + max(1, repeats))
         cur = jax.block_until_ready(jfn(params, cur))

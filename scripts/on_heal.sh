@@ -11,8 +11,9 @@
 # after eyeballing (this script never touches git).
 set -u
 cd "$(dirname "$0")/.."
-TS=$(date -u +%Y-%m-%dT%H:%MZ)
-LOG=logs/on_heal_${TS}.log
+TS=$(date -u +%Y-%m-%dT%H:%MZ)        # probe-log entries (ISO, matches file)
+FTS=$(date -u +%Y%m%d_%H%M)           # filename stamp (no colons)
+LOG=logs/on_heal_${FTS}.log
 say() { echo "=== $*" | tee -a "$LOG"; }
 
 say "probe"
@@ -29,10 +30,20 @@ timeout 3000 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
 
 say "attention A/B (non-causal + causal)"
-timeout 600 python scripts/attention_ab.py --dtype bf16 --lengths 512,2048,8192 2>/dev/null \
-    | tee perf/attention_ab_${TS}.json | tee -a "$LOG"
-timeout 600 python scripts/attention_ab.py --dtype bf16 --lengths 512,2048 --causal 2>/dev/null \
-    | tee perf/attention_ab_causal_${TS}.json | tee -a "$LOG"
+run_ab() {  # run_ab <outfile> <args...>: JSON rows -> outfile, all output -> LOG
+    local out=$1; shift
+    local tmp; tmp=$(mktemp)
+    if timeout 600 python scripts/attention_ab.py "$@" >"$tmp" 2>>"$LOG"; then
+        grep '^{' "$tmp" > "$out"
+        tee -a "$LOG" < "$out"
+    else
+        say "attention_ab $* FAILED (rc=$?) — see $LOG; no $out written"
+        cat "$tmp" >> "$LOG"
+    fi
+    rm -f "$tmp"
+}
+run_ab perf/attention_ab_${FTS}.json --dtype bf16 --lengths 512,2048,8192
+run_ab perf/attention_ab_causal_${FTS}.json --dtype bf16 --lengths 512,2048 --causal
 
 say "ring/ulysses flash engines at shards=1 on the real chip (Mosaic lowering proof)"
 timeout 600 python - <<'EOF' 2>&1 | grep -v WARNING | tee -a "$LOG"

@@ -51,3 +51,39 @@ def test_trace_writes_files(tmp_path):
     with profiling.trace(d):
         jax.block_until_ready(jax.jit(profiling.forward_annotated)(params, x))
     assert glob.glob(os.path.join(d, "**", "*"), recursive=True)
+
+
+def test_stage_fns_pallas_tier_matches_model():
+    """The pallas-tier stage chain composes to forward_blocks12_pallas
+    exactly (5 fused stages), so --breakdown attributes cost to the
+    kernels actually running under a v3_pallas config."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_model import (
+        forward_blocks12_pallas,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.profiling import stage_fns
+
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    stages = stage_fns(tier="pallas")
+    assert [n for n, _ in stages] == ["conv1+relu", "pool1", "conv2+relu", "pool2", "lrn2"]
+    cur = x
+    for _, fn in stages:
+        cur = fn(params, cur)
+    np.testing.assert_array_equal(
+        np.asarray(cur), np.asarray(forward_blocks12_pallas(params, x))
+    )
+
+
+def test_stage_fns_rejects_unknown_tier():
+    import pytest
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.profiling import stage_fns
+
+    with pytest.raises(ValueError, match="tier"):
+        stage_fns(tier="cuda")
