@@ -25,6 +25,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-len", type=int, default=128, help="training context length")
     p.add_argument("--attn", choices=["reference", "flash", "ring", "ulysses"], default="reference")
     p.add_argument("--shards", type=int, default=1, help="sp shards for ring/ulysses")
+    p.add_argument(
+        "--sp-engine",
+        choices=["einsum", "flash"],
+        default="einsum",
+        help="within-shard engine for ring/ulysses (ulysses+flash trains; "
+        "ring+flash is forward-only and rejected)",
+    )
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--period", type=int, default=8, help="repeating-pattern period")
     p.add_argument(
@@ -78,6 +85,20 @@ def main(argv=None) -> int:
                 f"--shards {args.shards} exceeds {jax.device_count()} available "
                 f"device(s) (use --fake-devices N on CPU)"
             )
+        elif args.sp_engine == "flash":
+            if args.attn == "ring":
+                err = (
+                    "--sp-engine flash with --attn ring is forward-only "
+                    "(per-hop LSE merge has no VJP) — training needs "
+                    "ulysses+flash or ring+einsum"
+                )
+            else:  # ulysses: local flash attends the FULL sequence
+                bq = min(128, args.seq_len)
+                if args.seq_len % bq:
+                    err = (
+                        f"--sp-engine flash needs --seq-len divisible by {bq} "
+                        f"(got {args.seq_len})"
+                    )
     if err is not None:
         print(err, file=sys.stderr)
         return 2
@@ -102,6 +123,7 @@ def main(argv=None) -> int:
     cfg = dataclasses.replace(
         TINY_LM,
         attn_impl=args.attn,
+        attn_engine=args.sp_engine,
         sp_shards=args.shards,
         max_len=max(TINY_LM.max_len, args.seq_len),
         n_experts=args.experts,

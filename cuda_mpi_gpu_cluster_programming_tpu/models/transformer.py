@@ -50,6 +50,21 @@ class TransformerConfig:
     # tensor-sharded over (shard_lm_params_tp's axis); ring/ulysses then
     # name it in their shard_map specs so CP and TP compose in one step.
     sp_head_axis: Optional[str] = None
+    # Within-shard engine for ring/ulysses: "einsum" (XLA score blocks,
+    # differentiable everywhere) or "flash" (Pallas kernel). Ulysses+flash
+    # remains differentiable (whole-sequence VJP); ring+flash is
+    # forward-only and rejected here because the LM exists to train.
+    attn_engine: str = "einsum"
+
+    def __post_init__(self):
+        if self.attn_engine not in ("einsum", "flash"):
+            raise ValueError(f"attn_engine must be einsum|flash, got {self.attn_engine!r}")
+        if self.attn_engine == "flash" and self.attn_impl == "ring":
+            raise ValueError(
+                "attn_engine='flash' with attn_impl='ring' is forward-only "
+                "(per-hop LSE merge has no VJP) — the LM trains, so use "
+                "ulysses+flash or ring+einsum"
+            )
     # Mixture-of-experts FFN (0 = dense). Top-1 (Switch) routing with a
     # capacity limit; the expert axis is what EP shards (see moe_ffn).
     n_experts: int = 0
@@ -123,14 +138,14 @@ def _attend(q, k, v, cfg: TransformerConfig, mesh=None):
 
         return ring_attention(
             q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh,
-            head_axis=cfg.sp_head_axis,
+            head_axis=cfg.sp_head_axis, engine=cfg.attn_engine,
         )
     if cfg.attn_impl == "ulysses":
         from ..parallel.sequence_parallel import ulysses_attention
 
         return ulysses_attention(
             q, k, v, n_shards=cfg.sp_shards, causal=True, mesh=mesh,
-            head_axis=cfg.sp_head_axis,
+            head_axis=cfg.sp_head_axis, engine=cfg.attn_engine,
         )
     raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 

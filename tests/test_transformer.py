@@ -9,6 +9,7 @@ import pytest
 
 from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
     TINY_LM,
+    TransformerConfig,
     forward_lm,
     init_transformer,
     lm_loss,
@@ -90,3 +91,14 @@ class TestTraining:
         # Gradients must match the single-device impl.
         ref_loss = lm_loss(params, tokens)
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+
+
+def test_attn_engine_validation():
+    """ulysses+flash trains (whole-sequence VJP); ring+flash is
+    forward-only and must be rejected at config time — the LM exists to
+    train."""
+    TransformerConfig(attn_impl="ulysses", attn_engine="flash")  # fine
+    with pytest.raises(ValueError, match="forward-only"):
+        TransformerConfig(attn_impl="ring", attn_engine="flash")
+    with pytest.raises(ValueError, match="attn_engine"):
+        TransformerConfig(attn_engine="warp")
