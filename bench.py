@@ -73,19 +73,34 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _error_json(msg: str, platform: str = "unknown") -> str:
-    return json.dumps(
-        {
-            "metric": METRIC,
-            "value": 0.0,
-            "unit": "img/s",
-            "vs_baseline": 0.0,
-            "error": msg,
-            "platform": platform,
-            "config": CONFIG,
-            "compute": COMPUTE,
-            "batch": BATCH,
-        }
-    )
+    out = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "error": msg,
+        "platform": platform,
+        "config": CONFIG,
+        "compute": COMPUTE,
+        "batch": BATCH,
+    }
+    # The tunneled chip can wedge for hours (see logs/probe_attempts_r03.log);
+    # a wedged round-end run must not erase the round's committed evidence.
+    # Attach the last committed good measurement, explicitly labeled stale —
+    # "value" above stays 0.0 because nothing was measured NOW.
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "perf", "bench_latest.json")
+        ) as f:
+            last = json.load(f)
+        if isinstance(last, dict) and isinstance(last.get("value"), (int, float)) and last["value"] > 0:
+            out["last_good"] = {**last, "stale": True}
+    except (OSError, ValueError):
+        # Never let the fallback break the error path itself: a malformed
+        # bench_latest.json must not erase the one JSON line the contract
+        # guarantees.
+        pass
+    return json.dumps(out)
 
 
 def _child() -> int:

@@ -68,11 +68,17 @@ def main(argv=None) -> int:
 
     # Argument-compatibility checks: fail with a clean rc=2 here instead of a
     # raw traceback from inside jit tracing (advisor finding, round 1).
+    from ..ops.flash_attention import flash_block
+
+    def flash_len_err(flag: str):
+        bq = flash_block(args.seq_len)
+        if args.seq_len % bq:
+            return f"{flag} needs --seq-len divisible by {bq} (got {args.seq_len})"
+        return None
+
     err = None
     if args.attn == "flash":
-        bq = min(128, args.seq_len)  # flash block size, clamped to L
-        if args.seq_len % bq:
-            err = f"--attn flash needs --seq-len divisible by {bq} (got {args.seq_len})"
+        err = flash_len_err("--attn flash")
     elif args.attn in ("ring", "ulysses"):
         if args.shards < 1:
             err = f"--shards must be >= 1, got {args.shards}"
@@ -93,12 +99,7 @@ def main(argv=None) -> int:
                     "ulysses+flash or ring+einsum"
                 )
             else:  # ulysses: local flash attends the FULL sequence
-                bq = min(128, args.seq_len)
-                if args.seq_len % bq:
-                    err = (
-                        f"--sp-engine flash needs --seq-len divisible by {bq} "
-                        f"(got {args.seq_len})"
-                    )
+                err = flash_len_err("--sp-engine flash")
     if err is not None:
         print(err, file=sys.stderr)
         return 2

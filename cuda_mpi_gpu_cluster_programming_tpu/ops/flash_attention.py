@@ -377,6 +377,14 @@ def _flash_diff_bwd(causal, block_q, block_k, res, g):
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+def flash_block(l: int, block_q: int = 128) -> int:
+    """The clamped flash block size for sequence length ``l`` — THE shared
+    source of the ``l % flash_block(l) == 0`` divisibility rule, so CLI
+    pre-checks (examples/lm.py) and the library validations
+    (sequence_parallel) cannot drift from the kernel's actual tiling."""
+    return min(block_q, l)
+
+
 def flash_attention_with_lse(
     q: jax.Array,
     k: jax.Array,

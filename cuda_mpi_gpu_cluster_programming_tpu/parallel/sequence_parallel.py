@@ -233,8 +233,10 @@ def ring_attention(
         # the PER-SHARD length must divide by its clamped block size —
         # validate here with global numbers, or the error would surface
         # from inside the shard_map trace quoting the shard-local length.
+        from ..ops.flash_attention import flash_block
+
         lb = l // n_shards
-        blk = min(128, lb)
+        blk = flash_block(lb)
         if lb % blk:
             raise ValueError(
                 f"engine='flash' needs the per-shard block (L/n = {lb}) to be "
@@ -334,7 +336,9 @@ def ulysses_attention(
     if engine not in ("einsum", "flash"):
         raise ValueError(f"engine must be einsum|flash, got {engine!r}")
     if engine == "flash":
-        blk = min(128, l)
+        from ..ops.flash_attention import flash_block
+
+        blk = flash_block(l)
         if l % blk:
             raise ValueError(
                 f"engine='flash' needs L ({l}) to be a multiple of the flash "
