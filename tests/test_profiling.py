@@ -87,3 +87,24 @@ def test_stage_fns_rejects_unknown_tier():
 
     with pytest.raises(ValueError, match="tier"):
         stage_fns(tier="cuda")
+
+
+def test_run_cli_breakdown_uses_config_tier(capsys):
+    """--breakdown on a pallas config prints the 5 fused kernel stages;
+    on an XLA-op config the 7-stage reference chain — the tier the user
+    selected is the tier that gets attributed."""
+    from cuda_mpi_gpu_cluster_programming_tpu.run import main
+
+    rc = main(["--config", "v3_pallas", "--batch", "1", "--breakdown",
+               "--repeats", "1", "--warmup", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    layers = [l for l in out.splitlines() if l.startswith("Layer ")]
+    assert len(layers) == 5 and layers[0].startswith("Layer conv1+relu")
+
+    rc = main(["--config", "v1_jit", "--batch", "1", "--breakdown",
+               "--repeats", "1", "--warmup", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    layers = [l for l in out.splitlines() if l.startswith("Layer ")]
+    assert len(layers) == 7 and layers[0].startswith("Layer conv1")
