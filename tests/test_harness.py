@@ -170,3 +170,17 @@ def test_run_case_subprocess_sweep(tmp_path):
     assert r3.status == harness.MESH_WARN
     with open(session.csv_path) as f:
         assert len(list(csv.reader(f))) == 4  # header + 3 rows
+
+
+def test_classify_remote_compile_5xx_is_env_warn():
+    """The tunnel's remote-compile relay fails transiently with HTTP 5xx
+    (observed round 3; same configs compiled clean minutes later) — an
+    environment fault, not a framework failure."""
+    log = (
+        "Devices: 1 x TPU v5 lite (tpu)\n"
+        "JaxRuntimeError: INTERNAL: http://127.0.0.1:8103/remote_compile: "
+        "HTTP 500: tpu_compile_helper subprocess exit code 1\n"
+    )
+    assert harness.classify(1, log) == harness.ENV_WARN
+    # a plain framework ValueError after the banner still FAILs
+    assert harness.classify(1, "Devices: 1 x TPU v5 lite (tpu)\nValueError: boom\n") == harness.FAIL
