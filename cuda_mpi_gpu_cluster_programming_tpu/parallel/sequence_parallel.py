@@ -164,6 +164,11 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
     return out.astype(q.dtype)
 
 
+def _validate_engine(engine: str) -> None:
+    if engine not in ("einsum", "flash"):
+        raise ValueError(f"engine must be einsum|flash, got {engine!r}")
+
+
 def _validate_mesh_axis_size(mesh, axis_name: str, n_shards: int) -> None:
     """n_shards must equal the mesh's axis size. Ring: the fori_loop runs
     n_shards hops and the ppermute permutation has n_shards entries, so a
@@ -333,8 +338,7 @@ def ulysses_attention(
                 f"head count {h} not divisible by sp x {head_axis} = "
                 f"{n_shards} x {tp} shards"
             )
-    if engine not in ("einsum", "flash"):
-        raise ValueError(f"engine must be einsum|flash, got {engine!r}")
+    _validate_engine(engine)
     if engine == "flash":
         from ..ops.flash_attention import flash_block
 

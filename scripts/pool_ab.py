@@ -4,8 +4,8 @@ The round-3 per-layer breakdown on the real v5e showed pool1 costing 5.1 ms
 at batch 128 — 4x conv1 — making the pool, not the conv, the Pallas tier's
 bottleneck. Candidates measured here:
 
-  current   ops.pallas_kernels.maxpool_pallas (host stride-phase stack ->
-            phase-indexed kernel taps)
+  current   the phase-stack lowering (pk._maxpool_phases — the pre-sep2
+            default: host stride-phase stack -> phase-indexed kernel taps)
   xla       jax.lax.reduce_window under jit — the compiler oracle
   phases    ONLY the host-side _pool_phases repack (isolates how much of
             `current` is the strided gather vs the kernel)

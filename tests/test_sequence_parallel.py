@@ -178,10 +178,9 @@ class TestRingTpComposition:
     @pytest.mark.parametrize("engine", ["einsum", "flash"])
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_reference(self, engine, causal):
-        from jax.sharding import Mesh
+        from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
 
-        devs = np.array(jax.devices()[:8]).reshape(4, 2)
-        mesh = Mesh(devs, ("sp", "tp"))
+        mesh = make_mesh(2, axis_name="tp", dp=4, dp_axis_name="sp")
         q, k, v = qkv(jax.random.PRNGKey(41), l=128, h=8)
         want = attention(q, k, v, causal=causal)
         got = ring_attention(
@@ -196,10 +195,9 @@ class TestRingTpComposition:
             ring_attention(q, k, v, n_shards=4, head_axis="tp")
 
     def test_head_divisibility_and_axis_validated(self):
-        from jax.sharding import Mesh
+        from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
 
-        devs = np.array(jax.devices()[:8]).reshape(4, 2)
-        mesh = Mesh(devs, ("sp", "tp"))
+        mesh = make_mesh(2, axis_name="tp", dp=4, dp_axis_name="sp")
         q, k, v = qkv(jax.random.PRNGKey(43), l=128, h=5)  # 5 % 2 != 0
         with pytest.raises(ValueError, match="head count"):
             ring_attention(q, k, v, n_shards=4, mesh=mesh, head_axis="tp")
@@ -214,10 +212,9 @@ class TestUlyssesTpComposition:
 
     @pytest.mark.parametrize("engine", ["einsum", "flash"])
     def test_matches_reference(self, engine):
-        from jax.sharding import Mesh
+        from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
 
-        devs = np.array(jax.devices()[:8]).reshape(4, 2)
-        mesh = Mesh(devs, ("sp", "tp"))
+        mesh = make_mesh(2, axis_name="tp", dp=4, dp_axis_name="sp")
         q, k, v = qkv(jax.random.PRNGKey(51), l=128, h=8)
         want = attention(q, k, v, causal=True)
         got = ulysses_attention(
@@ -227,10 +224,9 @@ class TestUlyssesTpComposition:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
     def test_combined_head_divisibility_validated(self):
-        from jax.sharding import Mesh
+        from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
 
-        devs = np.array(jax.devices()[:8]).reshape(4, 2)
-        mesh = Mesh(devs, ("sp", "tp"))
+        mesh = make_mesh(2, axis_name="tp", dp=4, dp_axis_name="sp")
         # h=4 divides sp=4 but not sp*tp=8
         q, k, v = qkv(jax.random.PRNGKey(52), l=128, h=4)
         with pytest.raises(ValueError, match="sp x"):
@@ -242,7 +238,7 @@ def test_lm_trains_with_ring_attention_and_megatron_tp():
     'sp' while Megatron TP shards heads/FFN over 'tp' — training works
     because the ring einsum engine is differentiable and GSPMD keeps the
     TP shardings through the optimizer."""
-    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as SP
 
     from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
@@ -251,6 +247,7 @@ def test_lm_trains_with_ring_attention_and_megatron_tp():
         init_transformer,
         make_lm_train_step,
     )
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
     from cuda_mpi_gpu_cluster_programming_tpu.parallel.tensor_parallel import (
         shard_lm_params_tp,
     )
@@ -262,7 +259,7 @@ def test_lm_trains_with_ring_attention_and_megatron_tp():
     base_cfg = dataclasses.replace(cfg, attn_impl="reference")
     params = init_transformer(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab)
-    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("sp", "tp"))
+    mesh = make_mesh(2, axis_name="tp", dp=4, dp_axis_name="sp")
     tp_params = shard_lm_params_tp(params, mesh, axis_name="tp")
     tokens_sh = jax.device_put(tokens, NamedSharding(mesh, SP()))
 
