@@ -231,8 +231,7 @@ def ring_attention(
     b, l, h, d = q.shape
     if l % n_shards != 0:
         raise ValueError(f"sequence length {l} not divisible by {n_shards} shards")
-    if engine not in ("einsum", "flash"):
-        raise ValueError(f"engine must be einsum|flash, got {engine!r}")
+    _validate_engine(engine)
     if engine == "flash":
         # The flash kernel tiles each shard's block at (up to) 128 rows, so
         # the PER-SHARD length must divide by its clamped block size —
