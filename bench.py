@@ -21,8 +21,10 @@ probes the device with a bounded subprocess, then runs the measurement in a
 second bounded subprocess, and emits the error JSON itself if either hangs.
 
 Tunables (env): BENCH_CONFIG (v1_jit), BENCH_COMPUTE (fp32|bf16), BENCH_BATCH
-(256 — won the on-TPU batch sweep), BENCH_PROBE_TIMEOUT (120 s),
-BENCH_TIMEOUT (900 s), BENCH_PEAK_TFLOPS (197 — TPU v5e bf16 MXU peak).
+(128 — the round-comparable default; sweeps opt into other sizes),
+BENCH_BF16 (1 — also measure a bf16 headline sub-object when the primary is
+fp32), BENCH_PROBE_TIMEOUT (120 s), BENCH_TIMEOUT (900 s),
+BENCH_PEAK_TFLOPS (197 — TPU v5e bf16 MXU peak).
 """
 
 import json
@@ -35,11 +37,12 @@ METRIC = "alexnet_blocks12_images_per_sec"
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
 COMPUTE = os.environ.get("BENCH_COMPUTE", "fp32")
-# 256 won the on-TPU batch sweep (perf/sweep_20260729_204754.json: 23.5k
-# img/s vs 21.8k at 128, fp32). fp32 keeps the comparison to the
-# reference's fp32-only V4 baseline apples-to-apples; bf16 rows (up to
-# ~143k img/s) are captured separately by the harness sweep.
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+# 128 is the round-over-round comparable default (advisor: the round-3
+# bump to 256 raised the headline via configuration, not code — sweeps opt
+# into 256 explicitly via BENCH_BATCH). fp32 keeps the comparison to the
+# reference's fp32-only V4 baseline apples-to-apples; a bf16 headline is
+# measured alongside and emitted as the "bf16" sub-object.
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "200"))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 BENCH_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", "900"))
@@ -69,7 +72,8 @@ def peak_tflops(device_kind: str) -> float:
             return peak
     return 197.0  # unknown kind: assume the chip we actually develop on
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, ROOT)
 
 
 def _error_json(msg: str, platform: str = "unknown") -> str:
@@ -87,14 +91,27 @@ def _error_json(msg: str, platform: str = "unknown") -> str:
     # The tunneled chip can wedge for hours (see logs/probe_attempts_r03.log);
     # a wedged round-end run must not erase the round's committed evidence.
     # Attach the last committed good measurement, explicitly labeled stale —
-    # "value" above stays 0.0 because nothing was measured NOW.
+    # "value" above stays 0.0 because nothing was measured NOW. Inside
+    # last_good the throughput field is renamed "stale_value" (advisor: no
+    # numeric field a value-scanner could mistake for fresh), while the
+    # top-level "value_last_good" gives scalar-only consumers an explicit
+    # machine-readable pointer to the committed headline.
     try:
-        with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), "perf", "bench_latest.json")
-        ) as f:
+        with open(os.path.join(ROOT, "perf", "bench_latest.json")) as f:
             last = json.load(f)
         if isinstance(last, dict) and isinstance(last.get("value"), (int, float)) and last["value"] > 0:
-            out["last_good"] = {**last, "stale": True}
+
+            def stale_rename(d: dict) -> dict:
+                # Recursive: the bf16 sub-object carries its own "value" that
+                # must not survive either (a value-scanner would read it as
+                # fresh just as readily as the top-level one).
+                r = {k: (stale_rename(v) if isinstance(v, dict) else v) for k, v in d.items()}
+                if "value" in r:
+                    r["stale_value"] = r.pop("value")
+                return r
+
+            out["last_good"] = {**stale_rename(last), "stale": True}
+            out["value_last_good"] = last["value"]
     except (OSError, ValueError):
         # Never let the fallback break the error path itself: a malformed
         # bench_latest.json must not erase the one JSON line the contract
@@ -120,58 +137,88 @@ def _child() -> int:
     from cuda_mpi_gpu_cluster_programming_tpu.utils.compile_cache import (
         enable_persistent_cache,
     )
-    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_ms
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_stats
 
     enable_persistent_cache()
     device = jax.devices()[0]
     platform = device.platform
     params = init_params_deterministic()
     x = deterministic_input(batch=BATCH)
-    fwd = build_forward(REGISTRY[CONFIG], compute=COMPUTE)
-
-    # Amortized fenced timing: on the tunneled TPU, block_until_ready alone
-    # over-reports throughput by orders of magnitude (see utils.timing).
-    per_pass_ms = amortized_ms(fwd, params, x, n_small=10, n_large=10 + REPEATS)
-    img_per_sec = BATCH / (per_pass_ms / 1e3)
-    flops = flops_per_image()
     mxu_flops = matmul_flops_per_image()
     peak = peak_tflops(device.device_kind)
-    # Conventional MFU: matmul-only FLOPs over the chip's bf16 MXU peak.
-    # Meaningless on CPU (no known peak), so null there.
-    mfu = (
-        round(img_per_sec * mxu_flops / (peak * 1e12), 4)
-        if platform != "cpu"
-        else None
-    )
-    # fp32 context: lax.Precision.HIGHEST synthesizes true-fp32 MACs out of
-    # 6 bf16 MXU passes, so the achievable fp32 ceiling is peak/6 — report
-    # the fraction of THAT ceiling alongside the bf16-peak MFU so the fp32
-    # headline is judged against what the hardware can actually do in fp32.
-    fp32_ceiling_frac = (
-        round(img_per_sec * mxu_flops / (peak / 6 * 1e12), 4)
-        if platform != "cpu" and COMPUTE == "fp32"
-        else None
-    )
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": round(img_per_sec, 1),
-                "unit": "img/s",
-                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
-                "mfu": mfu,
-                "fp32_ceiling_fraction": fp32_ceiling_frac,
-                "assumed_peak_tflops": peak if platform != "cpu" else None,
-                "device_kind": device.device_kind,
-                "flops_per_image": flops,
-                "matmul_flops_per_image": mxu_flops,
-                "platform": platform,
-                "config": CONFIG,
-                "compute": COMPUTE,
-                "batch": BATCH,
-            }
+
+    def measure(compute: str) -> dict:
+        fwd = build_forward(REGISTRY[CONFIG], compute=compute)
+        # Amortized fenced timing with a 100 ms work floor: on the tunneled
+        # TPU, block_until_ready alone over-reports throughput by orders of
+        # magnitude, and short chains carry ~40% relay-RTT variance (see
+        # utils.timing.amortized_stats).
+        st = amortized_stats(fwd, params, x, n_small=10, n_large=10 + REPEATS)
+        img_per_sec = BATCH / (st.per_call_ms / 1e3)
+        # Conventional MFU: matmul-only FLOPs over the chip's bf16 MXU peak.
+        # Meaningless on CPU (no known peak), so null there.
+        mfu = (
+            round(img_per_sec * mxu_flops / (peak * 1e12), 4)
+            if platform != "cpu"
+            else None
         )
-    )
+        # fp32 context: lax.Precision.HIGHEST synthesizes true-fp32 MACs out
+        # of 6 bf16 MXU passes, so the achievable fp32 ceiling is peak/6 —
+        # report the fraction of THAT ceiling alongside the bf16-peak MFU so
+        # the fp32 headline is judged against what the hardware can do in fp32.
+        fp32_ceiling_frac = (
+            round(img_per_sec * mxu_flops / (peak / 6 * 1e12), 4)
+            if platform != "cpu" and compute == "fp32"
+            else None
+        )
+        return {
+            "value": round(img_per_sec, 1),
+            "unit": "img/s",
+            "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
+            "mfu": mfu,
+            "fp32_ceiling_fraction": fp32_ceiling_frac,
+            "compute": compute,
+            "per_pass_ms": round(st.per_call_ms, 4),
+            "timing_n": st.n_samples,
+            "timing_ci95_ms": round(st.ci95_ms, 4),
+            "timing_chain": st.n_chain,
+            # shadowed = the RTT-shadow upper-bound fallback, NOT a converged
+            # difference — its ci95 of 0.0 means "one bound", not "precise".
+            # underconverged = hiccup pairs were discarded down to fewer than
+            # min_samples; the CI then reflects too few samples.
+            "timing_shadowed": st.shadowed,
+            "timing_underconverged": st.underconverged,
+        }
+
+    row = measure(COMPUTE)
+    out = {
+        "metric": METRIC,
+        **row,
+        "assumed_peak_tflops": peak if platform != "cpu" else None,
+        "device_kind": device.device_kind,
+        "flops_per_image": flops_per_image(),
+        "matmul_flops_per_image": mxu_flops,
+        "platform": platform,
+        "config": CONFIG,
+        "batch": BATCH,
+    }
+    # Flush the completed primary immediately: if the optional bf16 pass
+    # below pushes the child past BENCH_TIMEOUT, the parent salvages this
+    # line from the killed child's partial stdout instead of reporting 0.0.
+    print(json.dumps(out), flush=True)
+    # bf16 headline alongside the fp32 apples-to-apples row (round-3 verdict:
+    # the committed headline was fp32-only; the bf16 sub-object states the
+    # chip's actual capability, with its own MFU and n/CI fields). Skipped
+    # when the primary already is bf16 or on CPU (no second tier to show).
+    if COMPUTE == "fp32" and platform != "cpu" and os.environ.get("BENCH_BF16", "1") != "0":
+        # Never let the optional secondary destroy the completed primary: a
+        # bf16 failure (unsupported config, relay hiccup, mid-run wedge)
+        # degrades to an error note, not a value:0.0 round record.
+        try:
+            out["bf16"] = measure("bf16")
+        except Exception as e:
+            out["bf16"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps(out), flush=True)  # last line wins in the parent
     return 0
 
 
@@ -186,26 +233,54 @@ def main() -> int:
         return 0
     platform = info
 
-    # 2) Bounded measurement run; relay its JSON line.
-    try:
-        bench = subprocess.run(
-            [sys.executable, "-u", os.path.abspath(__file__), "--child"],
-            capture_output=True,
-            text=True,
-            timeout=BENCH_TIMEOUT,
-            cwd=here,
-        )
-    except subprocess.TimeoutExpired:
-        print(_error_json(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform))
-        return 0
-    json_line = next(
-        (l for l in reversed(bench.stdout.splitlines()) if l.startswith("{")), None
+    # 2) Bounded measurement run; relay its JSON line. Popen (not run()):
+    # subprocess.run's TimeoutExpired carries stdout=None on this platform,
+    # which would lose the primary row the child flushed before a bf16-pass
+    # wedge — kill-and-drain preserves it.
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=here,
     )
-    if bench.returncode != 0 or json_line is None:
-        tail = (bench.stderr or bench.stdout).strip().splitlines()[-1:] or ["no output"]
-        print(_error_json(f"benchmark failed (rc={bench.returncode}): {tail[0]}", platform))
+    timed_out = False
+    try:
+        stdout, stderr = proc.communicate(timeout=BENCH_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()
+        stdout, stderr = proc.communicate()
+    # Any PARSEABLE row beats the error JSON — a child that flushed the
+    # primary and then died in the optional bf16 pass (timeout, backend
+    # crash, rc!=0) still produced a valid fresh measurement. A SIGKILL can
+    # truncate the final line mid-write, so scan backwards for the newest
+    # line that actually parses (the flushed primary is always complete).
+    salvaged = None
+    for line in reversed((stdout or "").splitlines()):
+        if line.startswith("{"):
+            try:
+                salvaged = json.loads(line)
+                break
+            except ValueError:
+                continue
+    if salvaged is not None:
+        if timed_out or proc.returncode != 0:
+            # Annotate so the record shows bf16 was attempted and died,
+            # not deliberately skipped.
+            why = (
+                f"timed out after {BENCH_TIMEOUT:.0f}s"
+                if timed_out
+                else f"rc={proc.returncode}"
+            )
+            salvaged["salvaged"] = f"child killed after primary row ({why})"
+        print(json.dumps(salvaged))
         return 0
-    print(json_line)
+    if timed_out:
+        print(_error_json(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform))
+    else:
+        tail = ((stderr or stdout or "").strip().splitlines() or ["no output"])[-1:]
+        print(_error_json(f"benchmark failed (rc={proc.returncode}): {tail[0]}", platform))
     return 0
 
 

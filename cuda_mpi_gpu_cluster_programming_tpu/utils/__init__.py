@@ -1,1 +1,8 @@
-from .timing import time_fn_ms, amortized_ms, sync_fence, TimingResult  # noqa: F401
+from .timing import (  # noqa: F401
+    AmortizedStats,
+    TimingResult,
+    amortized_ms,
+    amortized_stats,
+    sync_fence,
+    time_fn_ms,
+)

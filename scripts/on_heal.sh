@@ -16,13 +16,14 @@ FTS=$(date -u +%Y%m%d_%H%M)           # filename stamp (no colons)
 LOG=logs/on_heal_${FTS}.log
 say() { echo "=== $*" | tee -a "$LOG"; }
 
+PROBE_LOG=${PROBE_LOG:-logs/probe_attempts_r04.log}   # round-current timeline
 say "probe"
 if ! timeout 120 python -u -c "import jax; print((jax.numpy.ones((8,8))@jax.numpy.ones((8,8))).sum())" >>"$LOG" 2>&1; then
     say "still wedged — aborting (nothing run)"
-    echo "${TS} WEDGED (on_heal probe)" >> logs/probe_attempts_r03.log
+    echo "${TS} WEDGED (on_heal probe)" >> "$PROBE_LOG"
     exit 3
 fi
-echo "${TS} OK (on_heal: queue started)" >> logs/probe_attempts_r03.log
+echo "${TS} OK (on_heal: queue started)" >> "$PROBE_LOG"
 
 say "capture_evidence (full matrix incl. sharded family)"
 timeout 3000 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"

@@ -36,7 +36,7 @@ def main() -> int:
         deterministic_input,
         init_params_deterministic,
     )
-    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_ms
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_stats
 
     # v6_full_jit rides along: the full-AlexNet extension is a bench
     # candidate too (its matmul-heavy FC head behaves differently from
@@ -70,7 +70,11 @@ def main() -> int:
             t0 = time.perf_counter()
             jax.block_until_ready(fwd(params, x))
             compile_s = time.perf_counter() - t0
-            ms = amortized_ms(fwd, params, x, n_small=10, n_large=10 + args.repeats)
+            # Work-floor stats (round-3 verdict: sub-3 ms bf16 rows carried
+            # ~40% session spread on short chains) — each point now reports
+            # its sample count and 95% CI alongside the median.
+            st = amortized_stats(fwd, params, x, n_small=10, n_large=10 + args.repeats)
+            ms = st.per_call_ms
             row = {
                 "config": key,
                 "compute": compute,
@@ -78,6 +82,11 @@ def main() -> int:
                 "ms_per_pass": round(ms, 4),
                 "img_per_sec": round(batch / (ms / 1e3), 1),
                 "compile_s": round(compile_s, 1),
+                "timing_n": st.n_samples,
+                "timing_ci95_ms": round(st.ci95_ms, 4),
+                "timing_chain": st.n_chain,
+                "timing_shadowed": st.shadowed,
+                "timing_underconverged": st.underconverged,
             }
         except Exception as e:  # record and continue the sweep
             row = {"config": key, "compute": compute, "batch": batch,

@@ -1,0 +1,80 @@
+"""utils.timing: the amortized protocol's statistics layer.
+
+The reference's timing is one std::chrono span per pass
+(v1_serial/src/alexnet_serial.cpp:174-176); here the tunneled-TPU relay
+forces the two-queue-length amortized protocol, and round 3 showed that a
+single short chain carries ~40% run-to-run variance on sub-3 ms passes.
+These tests pin the work-floor/CI mechanics on CPU, where wall time is real.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import (
+    AmortizedStats,
+    amortized_ms,
+    amortized_stats,
+)
+
+
+@jax.jit
+def _small(x):
+    return (x @ x).sum()
+
+
+X = jnp.ones((64, 64))
+
+
+def test_amortized_stats_fields_and_floor():
+    st = amortized_stats(_small, X, n_small=2, n_large=4, work_floor_ms=20.0,
+                         min_samples=3, max_samples=5)
+    assert isinstance(st, AmortizedStats)
+    assert st.per_call_ms > 0
+    # Scheduler noise on a loaded box can push even CPU runs into the
+    # shadowed single-sample fallback; the sample-count contract only
+    # applies to converged runs.
+    if not st.shadowed:
+        assert 3 <= st.n_samples <= 5
+    assert st.ci95_ms >= 0.0
+    assert st.total_measured_s > 0
+    # Work floor: the chain must have grown until one long run accumulated
+    # >= 20 ms — a 64x64 matmul is ~us-scale, so 4 calls can't reach it.
+    assert st.n_chain > 4 or st.shadowed
+
+
+def test_amortized_stats_single_sample_mode_matches_scalar_form():
+    st = amortized_stats(_small, X, n_small=2, n_large=4, work_floor_ms=0.0,
+                         min_samples=1, max_samples=1)
+    assert st.n_samples == 1
+    assert st.ci95_ms == 0.0
+    assert amortized_ms(_small, X, n_small=2, n_large=4) > 0
+
+
+def test_amortized_stats_validates_args():
+    with pytest.raises(ValueError):
+        amortized_stats(_small, X, n_small=4, n_large=4)
+    with pytest.raises(ValueError):
+        amortized_stats(_small, X, min_samples=5, max_samples=2)
+
+
+def test_underconverged_flag_defaults_and_semantics():
+    """A clean result is not underconverged; a result that ended below its
+    min_samples after discarding hiccup pairs must say so (ci95 of a tiny
+    sample set must not read as a passed convergence gate)."""
+    st = amortized_stats(_small, X, n_small=2, n_large=4, work_floor_ms=5.0,
+                         min_samples=2, max_samples=4)
+    assert st.shadowed or not st.underconverged  # CPU wall time is real: converges
+    degraded = AmortizedStats(samples_ms=[1.0], n_chain=64, shadowed=False,
+                              total_measured_s=1.0, underconverged=True)
+    assert degraded.ci95_ms == 0.0 and degraded.underconverged
+
+
+def test_median_resists_one_hiccup():
+    """The headline estimator is the median: one relay hiccup that doubles a
+    single sample must not move the reported per-call time."""
+    clean = AmortizedStats(samples_ms=[1.0, 1.01, 0.99], n_chain=64,
+                           shadowed=False, total_measured_s=1.0)
+    spiked = AmortizedStats(samples_ms=[1.0, 1.01, 0.99, 10.0], n_chain=64,
+                            shadowed=False, total_measured_s=1.0)
+    assert abs(spiked.per_call_ms - clean.per_call_ms) < 0.02
