@@ -88,19 +88,21 @@ def _backfill_platform(conn: sqlite3.Connection) -> None:
     resolve from the current cwd (src_csv is stored as ingested, often
     relative) — retrying each connect picks those rows up the next time
     the warehouse is opened from the right directory."""
+    # Reference-corpus rows intentionally stay NULL (platform is encoded in
+    # the variant name), so exclude them in SQL — otherwise every connect
+    # re-fetches and re-skips them forever (round-3 advisor finding); the
+    # steady-state scan only sees genuinely unresolved rows.
     rows = conn.execute(
         "SELECT rowid, src_csv, log_file, corpus FROM summary_runs "
-        "WHERE platform IS NULL"
+        "WHERE platform IS NULL "
+        "  AND COALESCE(corpus, '') != 'reference' "
+        "  AND src_csv IS NOT NULL AND src_csv != '' "
+        "  AND NOT (corpus IS NULL AND (src_csv LIKE '%/reference/%' "
+        "           OR src_csv LIKE '%reference_import%'))"
     ).fetchall()
     defaults: dict = {}
     n = 0
     for rowid, src_csv, log_file, corpus in rows:
-        is_ref = corpus == "reference" or (
-            corpus is None and src_csv
-            and ("/reference/" in src_csv or "reference_import" in src_csv)
-        )
-        if is_ref or not src_csv:
-            continue  # reference rows stay NULL (platform encoded in variant)
         csv_path = Path(src_csv)
         if csv_path not in defaults:
             defaults[csv_path] = _session_platform(csv_path)

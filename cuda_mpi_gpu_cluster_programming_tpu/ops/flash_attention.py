@@ -35,6 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF
+from .vma import vma_struct as _vma_struct
 
 
 def _interpret() -> bool:
@@ -115,7 +116,7 @@ def _fwd_kernel(
 _STAT_LANES = 128
 
 
-def _flash_forward(q, k, v, *, causal, block_q, block_k, return_lse):
+def _flash_forward(q, k, v, *, causal, block_q, block_k, return_lse, vma=None):
     b, l, h, d = q.shape
     bq = min(block_q, l)
     bk = min(block_k, l)
@@ -148,8 +149,8 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, return_lse):
             _spec((1, 1, 1, bq), lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, 1, l), jnp.float32),
+            _vma_struct((b, h, l, d), q.dtype, vma),
+            _vma_struct((b, h, 1, l), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, _STAT_LANES), jnp.float32),  # running max
@@ -252,7 +253,7 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
+def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k, vma=None):
     b, l, h, d = q.shape
     bq = min(block_q, l)
     bk = min(block_k, l)
@@ -287,7 +288,7 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
             _spec((1, 1, 1, bq), rowq),
         ],
         out_specs=_spec((1, 1, bq, d), qb),
-        out_shape=jax.ShapeDtypeStruct((b, h, l, d), q.dtype),
+        out_shape=_vma_struct((b, h, l, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
     )(qt, kt, vt, gt, lse, delta)
@@ -312,8 +313,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k):
             _spec((1, 1, bk, d), kb2),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, l, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, l, d), v.dtype),
+            _vma_struct((b, h, l, d), k.dtype, vma),
+            _vma_struct((b, h, l, d), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -339,6 +340,7 @@ def flash_attention(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    vma=None,
 ) -> jax.Array:
     """Fused attention. q,k,v: (B, L, H, D) -> (B, L, H, D).
 
@@ -349,28 +351,36 @@ def flash_attention(
     Differentiable with O(L)-memory: the custom VJP recomputes probabilities
     blockwise from the saved log-sum-exp (FlashAttention-2 backward) in two
     Pallas kernels — training at long L never materializes (L, L).
+
+    ``vma``: mesh axes this call varies over when used inside a
+    ``shard_map`` body with ``check_vma=True`` (e.g. the ulysses engine);
+    tags the kernels' out_shapes so the caller keeps the vma checker on.
     """
-    return _flash_diff(causal, block_q, block_k, q, k, v)
+    vma = tuple(vma) if vma is not None else None  # hashable static arg
+    return _flash_diff(causal, block_q, block_k, vma, q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _flash_diff(causal, block_q, block_k, q, k, v):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_diff(causal, block_q, block_k, vma, q, k, v):
     return _flash_forward(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k, return_lse=False
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        return_lse=False, vma=vma,
     )
 
 
-def _flash_diff_fwd(causal, block_q, block_k, q, k, v):
+def _flash_diff_fwd(causal, block_q, block_k, vma, q, k, v):
     out, lse = _flash_forward(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k, return_lse=True
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        return_lse=True, vma=vma,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_diff_bwd(causal, block_q, block_k, res, g):
+def _flash_diff_bwd(causal, block_q, block_k, vma, res, g):
     q, k, v, out, lse = res
     return _flash_backward(
-        q, k, v, out, lse, g, causal=causal, block_q=block_q, block_k=block_k
+        q, k, v, out, lse, g, causal=causal, block_q=block_q, block_k=block_k,
+        vma=vma,
     )
 
 
@@ -393,6 +403,7 @@ def flash_attention_with_lse(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    vma=None,
 ) -> tuple:
     """Forward-only fused attention returning ``(out, lse)``.
 
@@ -404,11 +415,35 @@ def flash_attention_with_lse(
         out  = exp(lse1 - lse) * out1 + exp(lse2 - lse) * out2
 
     which is what the ring-attention flash engine does per hop
-    (parallel.sequence_parallel). NOT differentiable — the custom VJP only
-    covers :func:`flash_attention`'s out-only signature; the training path
-    keeps the einsum engine.
+    (parallel.sequence_parallel). NOT differentiable — differentiating
+    raises NotImplementedError with the supported alternatives (the config
+    layer rejects ring+flash training up front; this guard gives library
+    users calling jax.grad directly the same clean message instead of an
+    opaque Pallas autodiff error). ``vma``: see :func:`flash_attention`.
     """
+    vma = tuple(vma) if vma is not None else None
+    return _flash_lse(causal, block_q, block_k, vma, q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_lse(causal, block_q, block_k, vma, q, k, v):
     out, lse = _flash_forward(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k, return_lse=True
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        return_lse=True, vma=vma,
     )
     return out, lse[:, :, 0, :]  # (B, H, 1, L) internal layout -> (B, H, L)
+
+
+def _flash_lse_fwd(causal, block_q, block_k, vma, q, k, v):
+    return _flash_lse(causal, block_q, block_k, vma, q, k, v), None
+
+
+def _flash_lse_bwd(causal, block_q, block_k, vma, res, g):
+    raise NotImplementedError(
+        "flash_attention_with_lse is forward-only: the per-hop LSE merge has "
+        "no VJP. For training use ulysses+flash (whole-sequence VJP) or "
+        "ring with engine='einsum'."
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

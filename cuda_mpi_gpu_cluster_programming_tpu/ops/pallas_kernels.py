@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from .vma import vma_struct
+
 try:  # pltpu is importable on CPU; only used for memory-space hints
     from jax.experimental.pallas import tpu as pltpu
 
@@ -213,17 +215,20 @@ def conv2d_pallas(
     padding: int = 0,
     padding_w: int | None = None,
     relu: bool = False,
+    vma=None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU) — thin wrapper resolving the
-    lowering variant from the environment before entering jit."""
+    lowering variant from the environment before entering jit. ``vma``: mesh
+    axes the call varies over inside a check_vma=True shard_map (ops.vma)."""
     return _conv2d_pallas(
         x, w, b, stride=stride, padding=padding, padding_w=padding_w,
         relu=relu, variant=_conv_variant(),
+        vma=tuple(vma) if vma is not None else None,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("stride", "padding", "padding_w", "relu", "variant")
+    jax.jit, static_argnames=("stride", "padding", "padding_w", "relu", "variant", "vma")
 )
 def _conv2d_pallas(
     x: jax.Array,
@@ -235,6 +240,7 @@ def _conv2d_pallas(
     padding_w: int | None = None,
     relu: bool = False,
     variant: str = "taps",
+    vma=None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU). x: (N,H,W,C), w: (F,F,C,K).
 
@@ -309,7 +315,7 @@ def _conv2d_pallas(
         grid=(n, nbh),
         in_specs=in_specs,
         out_specs=_vmem_spec((1, bh, wo_p, w.shape[-1]), lambda i, j: (i, j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, ho_p, wo_p, w.shape[-1]), x.dtype),
+        out_shape=vma_struct((n, ho_p, wo_p, w.shape[-1]), x.dtype, vma),
         compiler_params=_tc_params("parallel", "parallel"),
         interpret=_interpret(),
     )(*operands)
@@ -318,10 +324,10 @@ def _conv2d_pallas(
     return out
 
 
-def conv2d_pallas_hvalid(x, w, b, *, stride: int, padding_w: int):
+def conv2d_pallas_hvalid(x, w, b, *, stride: int, padding_w: int, vma=None):
     """Sharded-tier entry: VALID on H (halo-provided), padded on W, fused ReLU
     is NOT applied here (the sharded pipeline masks then relus)."""
-    return conv2d_pallas(x, w, b, stride=stride, padding=0, padding_w=padding_w)
+    return conv2d_pallas(x, w, b, stride=stride, padding=0, padding_w=padding_w, vma=vma)
 
 
 def _pool_kernel(x_ref, o_ref, *, window: int, stride: int, ho: int, wo: int):
@@ -366,16 +372,18 @@ def _pool_variant() -> str:
     return env_variant("TPU_FRAMEWORK_POOL", "sep2", ("sep2", "phases"))
 
 
-def maxpool_pallas(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+def maxpool_pallas(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Array:
     """Window max — thin wrapper resolving the lowering variant from the
-    environment before entering jit (same scope caveat as _conv_variant)."""
+    environment before entering jit (same scope caveat as _conv_variant).
+    ``vma``: see ops.vma."""
+    vma = tuple(vma) if vma is not None else None
     if _pool_variant() == "phases":
-        return _maxpool_phases(x, window=window, stride=stride)
-    return _maxpool_sep2(x, window=window, stride=stride)
+        return _maxpool_phases(x, window=window, stride=stride, vma=vma)
+    return _maxpool_sep2(x, window=window, stride=stride, vma=vma)
 
 
-@functools.partial(jax.jit, static_argnames=("window", "stride"))
-def _maxpool_phases(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("window", "stride", "vma"))
+def _maxpool_phases(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Array:
     n, h, wdt, c = x.shape
     s = stride
     ho = (h - window) // s + 1
@@ -389,7 +397,7 @@ def _maxpool_phases(x: jax.Array, *, window: int, stride: int) -> jax.Array:
         grid=(n,),
         in_specs=[_vmem_spec((s * s, 1, hp, wp, c), lambda i: (0, i, 0, 0, 0))],
         out_specs=_vmem_spec((1, ho, wo, c), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
+        out_shape=vma_struct((n, ho, wo, c), x.dtype, vma),
         compiler_params=_tc_params("parallel"),
         interpret=_interpret(),
     )(xph)
@@ -410,7 +418,7 @@ def _axis_pool_kernel(x_ref, o_ref, *, window: int, stride: int, ho: int):
     o_ref[0] = out
 
 
-def _pool_rows(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+def _pool_rows(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Array:
     """Max-pool the H axis via the view-reshape phase split. x: (N,H,W,C).
 
     The reshape H -> (hq, s) is contiguity-preserving — XLA emits no data
@@ -430,18 +438,18 @@ def _pool_rows(x: jax.Array, *, window: int, stride: int) -> jax.Array:
         grid=(n,),
         in_specs=[_vmem_spec((1, hq, s, w, c), lambda i: (i, 0, 0, 0, 0))],
         out_specs=_vmem_spec((1, ho, w, c), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, ho, w, c), x.dtype),
+        out_shape=vma_struct((n, ho, w, c), x.dtype, vma),
         compiler_params=_tc_params("parallel"),
         interpret=_interpret(),
     )(xv)
 
 
-@functools.partial(jax.jit, static_argnames=("window", "stride"))
-def _maxpool_sep2(x: jax.Array, *, window: int, stride: int) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("window", "stride", "vma"))
+def _maxpool_sep2(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Array:
     """Separable two-stage pool: rows, transpose, rows again, transpose."""
-    y = _pool_rows(x, window=window, stride=stride)      # (N, ho, W, C)
+    y = _pool_rows(x, window=window, stride=stride, vma=vma)  # (N, ho, W, C)
     yt = jnp.swapaxes(y, 1, 2)                           # (N, W, ho, C)
-    z = _pool_rows(yt, window=window, stride=stride)     # (N, wo, ho, C)
+    z = _pool_rows(yt, window=window, stride=stride, vma=vma)  # (N, wo, ho, C)
     return jnp.swapaxes(z, 1, 2)                         # (N, ho, wo, C)
 
 

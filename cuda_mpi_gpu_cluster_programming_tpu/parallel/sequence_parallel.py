@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import NEG_INF
+from ..ops.vma import kernel_check_vma
 from .mesh import make_mesh
 
 
@@ -122,19 +123,25 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
 
     b, lb, h, d = q.shape
     me = lax.axis_index(axis_name)
+    vary = tuple(vary_axes or (axis_name,))
+    tv = _to_varying_fn(vary)
 
+    # The kernel calls carry vma on their out_shapes and skip_fn pcasts its
+    # constants, so all three lax.switch branches type-check as varying and
+    # the shard_map keeps check_vma=True (scoped fix: the checker still
+    # guards the ppermutes and the LSE merge).
     def full_fn(q, kb, vb):
-        o, s = flash_attention_with_lse(q, kb, vb, causal=False)
+        o, s = flash_attention_with_lse(q, kb, vb, causal=False, vma=vary)
         return o.astype(jnp.float32), s
 
     def causal_fn(q, kb, vb):
-        o, s = flash_attention_with_lse(q, kb, vb, causal=True)
+        o, s = flash_attention_with_lse(q, kb, vb, causal=True, vma=vary)
         return o.astype(jnp.float32), s
 
     def skip_fn(q, kb, vb):
         return (
-            jnp.zeros((b, lb, h, d), jnp.float32),
-            jnp.full((b, h, lb), NEG_INF, jnp.float32),
+            tv(jnp.zeros((b, lb, h, d), jnp.float32)),
+            tv(jnp.full((b, h, lb), NEG_INF, jnp.float32)),
         )
 
     def step(t, carry):
@@ -157,7 +164,6 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, out, lse_new
 
-    tv = _to_varying_fn(vary_axes or (axis_name,))
     out0 = tv(jnp.zeros((b, lb, h, d), jnp.float32))
     lse0 = tv(jnp.full((b, h, lb), NEG_INF, jnp.float32))
     _, _, out, _ = lax.fori_loop(0, n_shards, step, (k, v, out0, lse0))
@@ -263,16 +269,16 @@ def ring_attention(
     spec = P(None, axis_name, head_axis, None)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        # pallas_call out_shapes carry no varying-mesh-axes (vma) metadata,
-        # so the vma checker rejects the flash engine inside shard_map
-        # (same workaround as the sharded conv tier, parallel/sharded.py);
-        # the einsum engine keeps the checker.
-        check_vma=(engine != "flash"),
+        # Flash engine: checker ON wherever the kernels can tag vma (real
+        # TPU) — ops.vma.kernel_check_vma; the blanket disable now only
+        # survives in interpret mode, where jax's own interpreter can't
+        # propagate vma. Einsum engine: always on.
+        check_vma=(engine != "flash" or kernel_check_vma()),
     )
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str):  # noqa: D401
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str, vary_axes=None):  # noqa: D401
     """Per-shard body: all_to_all L-shard -> H-shard, exact attention, back.
 
     After the reshard each shard holds the FULL sequence for its local
@@ -282,7 +288,13 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str):  # no
     dropping the (L, L) score residency of the einsum path.
     """
     if engine == "flash":
-        from ..ops.flash_attention import flash_attention as attention
+        from ..ops.flash_attention import flash_attention
+
+        # vma-tagged kernel out_shapes keep the caller's check_vma=True
+        # guarding the two all_to_alls (scoped round-3-advisor fix).
+        attention = functools.partial(
+            flash_attention, vma=tuple(vary_axes or (axis_name,))
+        )
     else:
         from ..ops.attention import attention
 
@@ -350,12 +362,14 @@ def ulysses_attention(
     if mesh is None:
         mesh = make_mesh(n_shards, axis_name=axis_name)
     body = functools.partial(
-        _ulysses_local, axis_name=axis_name, causal=causal, engine=engine
+        _ulysses_local, axis_name=axis_name, causal=causal, engine=engine,
+        vary_axes=(axis_name,) + ((head_axis,) if head_axis else ()),
     )
     spec = P(None, axis_name, head_axis, None)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        # same vma workaround as the ring flash engine / sharded conv tier
-        check_vma=(engine != "flash"),
+        # Same policy as ring: flash keeps the checker wherever the kernel
+        # can tag vma (real TPU); einsum always.
+        check_vma=(engine != "flash" or kernel_check_vma()),
     )
     return fn(q, k, v)

@@ -33,6 +33,7 @@ from jax import shard_map
 
 from ..models.alexnet import BLOCKS12, Blocks12Config
 from ..ops import reference as ops
+from ..ops.vma import kernel_check_vma
 from .halo import exchange
 from .mesh import make_mesh
 from .plan import LayerPlan, make_shard_plan
@@ -110,8 +111,15 @@ def build_sharded_forward(
     plan = make_shard_plan(model_cfg, n)
 
     if tier == "pallas":
-        from ..ops.pallas_kernels import conv2d_pallas_hvalid as conv_fn
-        from ..ops.pallas_kernels import maxpool_pallas as pool_fn
+        import functools
+
+        from ..ops.pallas_kernels import conv2d_pallas_hvalid, maxpool_pallas
+
+        # vma-tagged out_shapes (ops.vma) let this shard_map keep
+        # check_vma=True — previously the pallas tier forced the checker
+        # off for the whole body, halo ppermutes included.
+        conv_fn = functools.partial(conv2d_pallas_hvalid, vma=(AXIS,))
+        pool_fn = functools.partial(maxpool_pallas, vma=(AXIS,))
     else:
         conv_fn, pool_fn = _conv_hvalid, _pool_hvalid
 
@@ -143,11 +151,11 @@ def build_sharded_forward(
         mesh=mesh,
         in_specs=(P(), P(None, AXIS, None, None)),
         out_specs=P(None, AXIS, None, None),
-        # pallas_call out_shapes carry no varying-mesh-axes (vma) metadata,
-        # so the vma checker rejects the pallas tier inside shard_map; keep
-        # the checker for the reference tier, where it still catches
-        # replicated-vs-varying mistakes at trace time.
-        check_vma=(tier != "pallas"),
+        # Pallas tier: checker ON wherever the kernels can tag their
+        # out_shapes with vma (real TPU — ops.vma.kernel_check_vma); the
+        # disable now only survives in interpret mode. Reference tier:
+        # always on.
+        check_vma=(tier != "pallas" or kernel_check_vma()),
     )
 
     h_pad = n * plan.layers[0].b_in  # SPMD needs equal blocks: pad H to n*b0

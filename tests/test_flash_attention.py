@@ -149,3 +149,59 @@ def test_grad_matches_reference():
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-4)
+
+
+def test_with_lse_grad_raises_clean_not_implemented():
+    """Forward-only guard (round-3 advisor): jax.grad through
+    flash_attention_with_lse must raise the documented 'no VJP' message,
+    not an opaque Pallas autodiff error."""
+    import pytest
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    q, k, v = qkv(jax.random.PRNGKey(11), b=1, l=32, h=2, d=8)
+
+    def loss(q, k, v):
+        out, _ = flash_attention_with_lse(q, k, v, causal=True)
+        return jnp.sum(out**2)
+
+    with pytest.raises(NotImplementedError, match="LSE merge has no VJP"):
+        jax.grad(loss)(q, k, v)
+
+
+def test_ring_flash_grad_raises_clean_not_implemented():
+    """The same guard reached through ring_attention(engine='flash') — the
+    library path the advisor flagged."""
+    import pytest
+
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.sequence_parallel import (
+        ring_attention,
+    )
+
+    q, k, v = qkv(jax.random.PRNGKey(12), b=1, l=32, h=2, d=8)
+
+    def loss(q, k, v):
+        out = ring_attention(q, k, v, n_shards=2, causal=True, engine="flash")
+        return jnp.sum(out**2)
+
+    with pytest.raises(NotImplementedError, match="LSE merge has no VJP"):
+        jax.grad(loss)(q, k, v)
+
+
+def test_vma_struct_policy():
+    """vma tagging: plain without axes; dropped in interpret mode (CPU test
+    backend), where kernel_check_vma also prescribes the checker off."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.vma import (
+        interpret_mode,
+        kernel_check_vma,
+        vma_struct,
+    )
+
+    assert vma_struct((2, 2), "float32").vma is None
+    assert interpret_mode()  # the test mesh is the CPU backend
+    assert kernel_check_vma() is False
+    # In interpret mode the tag is dropped (jax's interpreter cannot
+    # propagate vma through discharged kernels).
+    assert vma_struct((2, 2), "float32", ("sp",)).vma is None
