@@ -1,0 +1,156 @@
+"""Static per-layer comm/compute breakdown for the row-sharded configs.
+
+The reference *planned* exactly this and never built it (reference
+README.md:233: "per-phase comm/compute/H2D breakdown" under future work).
+On this framework it falls out of the static shard plan: halo widths are
+Python ints at trace time (parallel/plan.py), so per-layer communication
+bytes, ppermute hop counts, FLOPs, and arithmetic intensity are exact
+static quantities — no profiler needed. The prediction is cross-checked
+against the compiled program: the jaxpr of the sharded forward must
+contain exactly the predicted number of halo collectives
+(tests/test_breakdown.py), so the table can never drift from what
+actually runs.
+
+FLOP conventions (stated so the numbers are auditable):
+- conv: 2 * F^2 * C_in * K multiply-adds per output element.
+- pool: window^2 max-compares per output element (counted as 1 "flop").
+- lrn:  (2*size + 4) per element — size squares+adds for the window sum,
+  plus square/scale/pow/div.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Tuple
+
+from ..models.alexnet import Blocks12Config, ConvSpec, LrnSpec, PoolSpec
+from ..ops.shapes import conv_out_dim, pool_out_dim
+from .plan import make_shard_plan
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerCost:
+    """Static per-shard cost of one layer on an n-shard row mesh."""
+
+    name: str
+    kind: str           # conv | pool | pointwise
+    h_top: int          # halo rows pulled from above
+    h_bot: int          # halo rows pulled from below
+    collectives: int    # ppermutes (or all_gathers when staged) this layer emits
+    halo_bytes: int     # bytes this shard RECEIVES for the exchange (per pass)
+    flops: int          # per-shard compute (convention in module docstring)
+    out_shape: Tuple[int, int, int]  # per-shard (b_out, W_out, C_out)
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity against communicated bytes: FLOPs per halo
+        byte (inf for layers that communicate nothing)."""
+        return self.flops / self.halo_bytes if self.halo_bytes else float("inf")
+
+
+def comm_compute_breakdown(
+    cfg: Blocks12Config,
+    n_shards: int,
+    batch: int = 1,
+    dtype_bytes: int = 4,
+    staged: bool = False,
+) -> List[LayerCost]:
+    """Per-layer static costs for the halo/staged_halo strategies.
+
+    ``staged`` mirrors ``halo_exchange_gathered`` (the V4 host-staging
+    analogue): one all_gather moving every shard's full block instead of
+    multi-hop ppermutes moving only the halo rows — the per-layer byte
+    ratio IS the V4-vs-V5 pedagogy, now stated statically.
+    """
+    plan = make_shard_plan(cfg, n_shards)
+    rows: List[LayerCost] = []
+    w_cur, c_cur = cfg.in_width, cfg.in_channels
+    for (name, spec), lp in zip(cfg.layer_chain(), plan.layers):
+        if isinstance(spec, ConvSpec):
+            w_out = conv_out_dim(w_cur, spec.filter_size, spec.padding, spec.stride)
+            c_out = spec.out_channels
+            flops = 2 * spec.filter_size**2 * c_cur * c_out * lp.b_out * w_out
+        elif isinstance(spec, PoolSpec):
+            w_out = pool_out_dim(w_cur, spec.window, spec.stride)
+            c_out = c_cur
+            flops = spec.window**2 * lp.b_out * w_out * c_out
+        elif isinstance(spec, LrnSpec):
+            w_out, c_out = w_cur, c_cur
+            flops = (2 * spec.size + 4) * lp.b_out * w_out * c_out
+        else:  # pragma: no cover - layer_chain only yields the three kinds
+            raise TypeError(f"unknown layer spec {spec!r}")
+        needs_halo = (lp.h_top + lp.h_bot) > 0
+        if staged:
+            collectives = 1 if needs_halo else 0
+            moved_rows = n_shards * lp.b_in if needs_halo else 0
+        else:
+            collectives = math.ceil(lp.h_top / lp.b_in) + math.ceil(lp.h_bot / lp.b_in)
+            moved_rows = lp.h_top + lp.h_bot
+        rows.append(
+            LayerCost(
+                name=name,
+                kind=lp.kind,
+                h_top=lp.h_top,
+                h_bot=lp.h_bot,
+                collectives=collectives,
+                halo_bytes=batch * moved_rows * w_cur * c_cur * dtype_bytes,
+                flops=batch * flops,
+                out_shape=(lp.b_out, w_out, c_out),
+            )
+        )
+        w_cur, c_cur = w_out, c_out
+    return rows
+
+
+def expected_collectives(cfg: Blocks12Config, n_shards: int, staged: bool = False) -> int:
+    """Total halo collectives one sharded forward pass must contain —
+    the number the compiled jaxpr is asserted against."""
+    return sum(r.collectives for r in comm_compute_breakdown(cfg, n_shards, staged=staged))
+
+
+def count_primitive(jaxpr, name: str) -> int:
+    """Occurrences of primitive ``name`` anywhere in ``jaxpr`` (recursing
+    into pjit/shard_map/scan/cond sub-jaxprs via eqn params)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
+    total = 0
+    for eqn in inner.eqns:
+        if eqn.primitive.name == name:
+            total += 1
+        for p in eqn.params.values():
+            for sub in _jaxprs_in(p):
+                total += count_primitive(sub, name)
+    return total
+
+
+def _jaxprs_in(p) -> list:
+    if hasattr(p, "eqns") or hasattr(p, "jaxpr"):
+        return [p]
+    if isinstance(p, (list, tuple)):
+        return [s for q in p for s in _jaxprs_in(q)]
+    return []
+
+
+def format_table(rows: List[LayerCost], staged: bool = False) -> str:
+    """Human table for run.py --breakdown (stdout contract: one line per
+    layer prefixed 'Comm ' so the harness can regex it like timing lines)."""
+    kind = "all_gather" if staged else "ppermute"
+    out = [
+        f"Per-layer comm/compute plan ({kind} transport):",
+        f"{'layer':8s} {'halo(t/b)':>9s} {'coll':>4s} {'KiB/pass':>9s} "
+        f"{'MFLOP':>8s} {'flop/byte':>9s}",
+    ]
+    for r in rows:
+        inten = f"{r.intensity:9.1f}" if r.halo_bytes else "      inf"
+        out.append(
+            f"Comm {r.name:8s} {r.h_top:4d}/{r.h_bot:<4d} {r.collectives:4d} "
+            f"{r.halo_bytes / 1024:9.1f} {r.flops / 1e6:8.1f} {inten}"
+        )
+    total_b = sum(r.halo_bytes for r in rows)
+    total_f = sum(r.flops for r in rows)
+    total_c = sum(r.collectives for r in rows)
+    out.append(
+        f"Comm TOTAL    {'':9s} {total_c:4d} {total_b / 1024:9.1f} "
+        f"{total_f / 1e6:8.1f} {total_f / total_b if total_b else float('inf'):9.1f}"
+    )
+    return "\n".join(out)

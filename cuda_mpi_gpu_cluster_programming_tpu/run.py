@@ -229,6 +229,22 @@ def main(argv=None) -> int:
         ):
             shape_s = "x".join(str(d) for d in shape[1:])
             print(f"Layer {name} completed in {ms:.3f} ms -> {shape_s}")
+        if exec_cfg.strategy in ("halo", "staged_halo"):
+            # Static comm/compute plan for the sharded strategies — the
+            # per-phase breakdown the reference listed as future work
+            # (reference README.md:233); exact because the halo geometry
+            # is Python ints at trace time (parallel/plan.py). The same
+            # numbers are asserted against the compiled jaxpr's collective
+            # count in tests/test_breakdown.py.
+            from .parallel.breakdown import comm_compute_breakdown, format_table
+
+            staged = exec_cfg.strategy == "staged_halo"
+            dtype_bytes = 2 if args.compute == "bf16" else 4
+            rows = comm_compute_breakdown(
+                blocks_cfg, args.shards, batch=args.batch,
+                dtype_bytes=dtype_bytes, staged=staged,
+            )
+            print(format_table(rows, staged=staged))
     return 0
 
 

@@ -1,0 +1,82 @@
+"""Static comm/compute breakdown vs the compiled program.
+
+The table (parallel/breakdown.py) claims exact per-layer halo bytes and
+collective counts; these tests pin the claim to reality by counting the
+actual collectives in the jaxpr of the compiled sharded forward — if the
+halo machinery ever emits a different number of ppermutes/all_gathers
+than the plan predicts, this fails at trace time, no TPU needed.
+"""
+
+import jax
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    deterministic_input,
+    init_params_deterministic,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.parallel.breakdown import (
+    comm_compute_breakdown,
+    count_primitive,
+    expected_collectives,
+    format_table,
+)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_plan_matches_jaxpr_ppermute_count(n):
+    """v2.2 (multi-hop ppermute transport): the jaxpr of one sharded
+    forward contains exactly the predicted number of ppermutes."""
+    fwd = build_forward(REGISTRY["v2.2_sharded"], n_shards=n)
+    params = init_params_deterministic()
+    x = deterministic_input(batch=2)
+    jaxpr = jax.make_jaxpr(fwd)(params, x)
+    assert count_primitive(jaxpr, "ppermute") == expected_collectives(BLOCKS12, n)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_plan_matches_jaxpr_all_gather_count_staged(n):
+    """v4 (staged transport): one all_gather per halo-needing layer."""
+    fwd = build_forward(REGISTRY["v4_hybrid"], n_shards=n)
+    params = init_params_deterministic()
+    x = deterministic_input(batch=2)
+    jaxpr = jax.make_jaxpr(fwd)(params, x)
+    assert count_primitive(jaxpr, "all_gather") == expected_collectives(
+        BLOCKS12, n, staged=True
+    )
+
+
+def test_breakdown_layer_values():
+    """Spot-check the static numbers: conv1's halo bytes follow directly
+    from the plan geometry, and the pointwise LRN communicates nothing."""
+    rows = comm_compute_breakdown(BLOCKS12, 4, batch=2, dtype_bytes=4)
+    by_name = {r.name: r for r in rows}
+    c1 = by_name["conv1"]
+    assert c1.halo_bytes == 2 * (c1.h_top + c1.h_bot) * 227 * 3 * 4
+    assert c1.flops == 2 * (2 * 11 * 11 * 3 * 96) * c1.out_shape[0] * c1.out_shape[1]
+    lrn = by_name["lrn2"]
+    assert lrn.halo_bytes == 0 and lrn.collectives == 0
+    assert lrn.intensity == float("inf")
+    # conv arithmetic intensity dwarfs pool's: the conv recomputes 2*F^2*C*K
+    # per element while pool only max-compares its window.
+    assert c1.intensity > by_name["pool1"].intensity
+
+
+def test_staged_moves_more_bytes_than_ppermute():
+    """The V4-vs-V5 pedagogy, stated statically: the all_gather transport
+    moves strictly more bytes than the halo-only ppermute transport."""
+    halo = comm_compute_breakdown(BLOCKS12, 4, batch=1)
+    staged = comm_compute_breakdown(BLOCKS12, 4, batch=1, staged=True)
+    assert sum(r.halo_bytes for r in staged) > sum(r.halo_bytes for r in halo)
+
+
+def test_format_table_contract():
+    """One 'Comm <layer>' line per layer plus header+total — the stdout
+    contract run.py --breakdown emits for sharded configs."""
+    rows = comm_compute_breakdown(BLOCKS12, 2)
+    text = format_table(rows)
+    comm_lines = [l for l in text.splitlines() if l.startswith("Comm ")]
+    assert len(comm_lines) == len(rows) + 1  # layers + TOTAL
+    assert "ppermute" in text
+    assert "all_gather" in format_table(rows, staged=True)
