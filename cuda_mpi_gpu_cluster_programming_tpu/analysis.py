@@ -619,6 +619,271 @@ def cmd_report(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
     print(f"wrote {out} ({n_perf} perf runs, {sessions} sessions)")
 
 
+# Stage map for the narrative: canonical variant name (shared with the
+# reference's corpus), this framework's config key, and what the stage IS.
+# The names are the join key between the two corpora, so the narrative can
+# put the reference's GPU/MPI measurements and the TPU re-design's
+# measurements in one story (reference analysis.md's canonical-name
+# discipline, canonical_version_name).
+_STAGES = (
+    ("V1 Serial", "v1_jit", "single-device XLA baseline (reference: serial C++)"),
+    ("V2.1 BroadcastAll", "v2.1_replicated", "replicate-compute-everywhere (the negative-scaling pedagogy stage)"),
+    ("V2.2 ScatterHalo", "v2.2_sharded", "row-sharded + multi-hop ppermute halos (reference: MPI scatter+halo)"),
+    ("V3 CUDA", "v3_pallas", "hand-written kernels (Pallas MXU vs reference CUDA)"),
+    ("V4 MPI+CUDA", "v4_hybrid", "sharded + all_gather-staged halos (reference: host-staged MPI+CUDA)"),
+    ("V5 MPI+CUDA-Aware", "v5_collective", "device-device halos over ICI (reference: planned, never built)"),
+    ("V6 AlexNet Full", "v6_full_jit", "full 8-layer AlexNet + FC head (beyond the reference's blocks 1-2)"),
+    ("V7 TensorParallel", "v7_tp", "conv-K tensor parallelism (beyond the reference)"),
+)
+
+
+def cmd_narrative(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
+    """The H7 narrative artifact: a regenerable reference-vs-TPU story woven
+    from the warehouse — per-stage comparison, scaling pedagogy, MFU, and
+    the static comm plan — not a table dump (that's ``report``). The
+    reference's equivalent is its ``analysis.md``/notebook walk-through."""
+    import datetime
+    import json as _json
+
+    L: List[str] = []
+    say = L.append
+    say("# Analysis narrative: the staged study, reference GPU/MPI vs TPU re-design")
+    say("")
+    say(
+        f"Generated {datetime.datetime.now(datetime.timezone.utc).strftime('%Y-%m-%d %H:%M UTC')} "
+        "by `python -m cuda_mpi_gpu_cluster_programming_tpu.analysis narrative` "
+        "from the measurement warehouse (re-run after any capture to refresh)."
+    )
+    say("")
+
+    # --- 1. The study -----------------------------------------------------
+    say("## 1. What is being compared")
+    say("")
+    say(
+        "The reference project tells a staged story — serial C++, naive "
+        "replication, scatter+halo MPI, CUDA kernels, hybrid MPI+CUDA — each "
+        "stage measured on the same AlexNet blocks-1-2 workload. This "
+        "framework re-designs every stage TPU-first (XLA/Pallas/shard_map "
+        "over a device mesh) and ingests the reference's own measurement "
+        "corpus next to its own, so both sit in one warehouse under "
+        "canonical stage names:"
+    )
+    say("")
+    say("| stage | TPU config | what it is |")
+    say("|---|---|---|")
+    for name, key, desc in _STAGES:
+        say(f"| {name} | `{key}` | {desc} |")
+    say("")
+    n_ref = conn.execute(
+        "SELECT COUNT(*) FROM summary_runs WHERE corpus='reference'"
+    ).fetchone()[0]
+    n_loc = conn.execute(
+        "SELECT COUNT(*) FROM summary_runs WHERE corpus!='reference'"
+    ).fetchone()[0]
+    say(
+        f"Warehouse contents: {n_ref} reference-corpus rows (the reference's "
+        f"committed CSVs/logs) and {n_loc} rows from this framework's own "
+        "sessions, keyed by (corpus, platform) so nothing is ever judged "
+        "against another machine's baseline."
+    )
+    say("")
+
+    # --- 2. Headline ------------------------------------------------------
+    say("## 2. Headline")
+    say("")
+    bench_path = Path("perf/bench_latest.json")
+    if bench_path.exists():
+        try:
+            bl = _json.loads(bench_path.read_text())
+        except ValueError:
+            bl = {}
+        if bl.get("value"):
+            say(
+                f"The committed headline (`perf/bench_latest.json`): "
+                f"**{bl['value']:,.0f} img/s** {bl.get('compute', 'fp32')} at "
+                f"batch {bl.get('batch', '?')} on the {bl.get('device_kind', 'TPU')} "
+                f"— {bl.get('vs_baseline', 0):,.0f}x the reference's best GPU "
+                "stage (V4 MPI+CUDA, RTX-3090-class, 0.183 s/image — "
+                "reference best_runs.md)."
+            )
+            if bl.get("mfu") is not None:
+                say("")
+                say(
+                    f"MFU {bl['mfu']:.3f} against the chip's bf16 MXU peak"
+                    + (
+                        f"; fp32 runs synthesize true-fp32 from ~6 bf16 MXU "
+                        f"passes, so the same measurement is "
+                        f"**{bl['fp32_ceiling_fraction']:.0%} of the "
+                        f"achievable fp32 ceiling**."
+                        if bl.get("fp32_ceiling_fraction")
+                        else "."
+                    )
+                )
+            if isinstance(bl.get("bf16"), dict) and bl["bf16"].get("value"):
+                b16 = bl["bf16"]
+                say("")
+                say(
+                    f"bf16 headline alongside: **{b16['value']:,.0f} img/s** "
+                    f"(MFU {b16.get('mfu', 0):.3f}, n={b16.get('timing_n', '?')}, "
+                    f"ci95 {b16.get('timing_ci95_ms', 0):.3f} ms)."
+                )
+    else:
+        say("No committed headline yet (perf/bench_latest.json absent).")
+    say("")
+
+    # --- 3. Stage by stage ------------------------------------------------
+    say("## 3. The staged comparison, on chip")
+    say("")
+    say(
+        "Per-image best times (min over ingested runs; ours are ms/batch at "
+        "the best batch, the reference's corpus is batch-1 by construction):"
+    )
+    say("")
+    say("| stage | reference best (ms/img, np) | TPU best (ms/img, batch) | TPU vs ref |")
+    say("|---|---|---|---|")
+    pending = []
+    for name, key, _ in _STAGES:
+        ref = conn.execute(
+            "SELECT MIN(best_ms), np FROM best_runs "
+            "WHERE corpus='reference' AND variant=?",
+            (name,),
+        ).fetchone()
+        # best_ms > 0.001 excludes rows at the timing clamp floor (1e-3 ms
+        # = the documented RTT-shadow fabrication from pre-work-floor
+        # sessions, utils/timing.py) — a 0.001 ms "measurement" is a bound
+        # that was explicitly not trusted, not a best run.
+        tpu = conn.execute(
+            "SELECT MIN(best_ms / COALESCE(batch, 1)) FROM best_runs "
+            "WHERE corpus!='reference' AND platform='tpu' AND variant=? "
+            "AND best_ms > 0.001",
+            (name,),
+        ).fetchone()
+        ref_s = f"{ref[0]:.1f} (np={ref[1]})" if ref and ref[0] else "—"
+        if tpu and tpu[0]:
+            tpu_s = f"{tpu[0]:.3f}"
+            ratio = f"**{ref[0] / tpu[0]:,.0f}x**" if ref and ref[0] else "—"
+        else:
+            tpu_s, ratio = "*pending capture*", "—"
+            pending.append(name)
+        say(f"| {name} | {ref_s} | {tpu_s} | {ratio} |")
+    say("")
+    if pending:
+        say(
+            f"Stages still without an on-chip row: {', '.join(pending)} — "
+            "queued in `scripts/on_heal.sh` (the tunneled chip wedges for "
+            "hours at a time; `logs/probe_attempts_r*.log` is the timeline). "
+            "Regenerate this narrative after the capture lands."
+        )
+    else:
+        say("Every stage the reference measured has an on-chip row.")
+    say("")
+
+    # --- 4. Scaling pedagogy ----------------------------------------------
+    say("## 4. The scaling pedagogy (reference corpus)")
+    say("")
+    rows = [
+        r
+        for r in conn.execute(SPEEDUP_SQL, (baseline,))
+        if r[6] == "reference"
+    ]
+    v21 = sorted((r for r in rows if r[0] == "V2.1 BroadcastAll"), key=lambda r: r[1])
+    v22 = sorted((r for r in rows if r[0] == "V2.2 ScatterHalo"), key=lambda r: r[1])
+    if v21:
+        curve = ", ".join(f"S({r[1]})={r[4]:.2f}" for r in v21)
+        say(
+            f"V2.1 BroadcastAll is the study's negative result and its best "
+            f"lesson: every rank recomputes everything, so adding ranks only "
+            f"adds broadcast cost — the reference's own corpus shows "
+            f"{curve}. The TPU analogue (`v2.1_replicated`) keeps the stage "
+            "as a measured config precisely to reproduce this curve."
+        )
+        say("")
+    if v22:
+        curve = ", ".join(f"S({r[1]})={r[4]:.2f}" for r in v22)
+        say(
+            f"V2.2 ScatterHalo actually divides work ({curve}); its TPU "
+            "analogue moves the same halos device-to-device over ICI via "
+            "multi-hop `ppermute` instead of MPI_Irecv/Isend, and the exact "
+            "row-ownership planner (parallel/plan.py) fixes the trim bug "
+            "that corrupted the reference's np=4 gathers."
+        )
+        say("")
+    if not (v21 or v22):
+        say("(reference corpus not ingested — run the capture/ingest first)")
+        say("")
+
+    # --- 5. Where the bytes go --------------------------------------------
+    say("## 5. Where the bytes go (static comm/compute plan, 4 shards)")
+    say("")
+    try:
+        from .models.alexnet import BLOCKS12
+        from .parallel.breakdown import comm_compute_breakdown
+
+        halo = comm_compute_breakdown(BLOCKS12, 4)
+        staged = comm_compute_breakdown(BLOCKS12, 4, staged=True)
+        say("| layer | halo rows (t/b) | collectives | KiB/pass | MFLOP | flop/byte |")
+        say("|---|---|---:|---:|---:|---:|")
+        for r in halo:
+            inten = f"{r.intensity:.1f}" if r.halo_bytes else "∞"
+            say(
+                f"| {r.name} | {r.h_top}/{r.h_bot} | {r.collectives} "
+                f"| {r.halo_bytes / 1024:.1f} | {r.flops / 1e6:.1f} | {inten} |"
+            )
+        hb = sum(r.halo_bytes for r in halo)
+        sb = sum(r.halo_bytes for r in staged)
+        say("")
+        say(
+            f"The staged (V4-style all_gather) transport would move "
+            f"{sb / 1024:.0f} KiB/pass against the halo-only ppermute "
+            f"transport's {hb / 1024:.0f} KiB — **{sb / hb:.1f}x more bytes "
+            "for identical math**, which is the V4-vs-V5 story stated "
+            "statically; tests assert the compiled jaxpr contains exactly "
+            "these collective counts (tests/test_breakdown.py)."
+        )
+    except Exception as e:  # narrative must never fail the pipeline
+        say(f"(static plan unavailable: {e})")
+    say("")
+
+    # --- 6. Measurement discipline ----------------------------------------
+    say("## 6. Measurement discipline")
+    say("")
+    cells = conn.execute(
+        "SELECT COUNT(*), SUM(CASE WHEN n >= 3 THEN 1 ELSE 0 END), "
+        "MAX(CASE WHEN n >= 2 THEN ci95_ms END) FROM run_stats "
+        "WHERE corpus!='reference' AND platform='tpu'"
+    ).fetchone()
+    if cells and cells[0]:
+        say(
+            f"{cells[0]} on-chip (variant, np, batch) cells; "
+            f"{cells[1] or 0} with n>=3 samples; worst 95% CI "
+            f"{cells[2]:.3f} ms." if cells[2] is not None else
+            f"{cells[0]} on-chip cells (single samples so far)."
+        )
+    else:
+        say("No on-chip cells yet.")
+    say("")
+    say(
+        "Timing protocol: the tunneled chip's `block_until_ready` is "
+        "optimistic, so every number uses the amortized two-queue-length "
+        "fence with a 100 ms work floor and a MAD-based CI on the median "
+        "(utils/timing.py) — sub-3 ms rows previously carried ~40% "
+        "session-to-session spread; the work floor is the fix. Device "
+        "wedges are first-class: probes, triage, and the stale-labeled "
+        "bench fallback are all tested code paths, and every probe attempt "
+        "is logged."
+    )
+    say("")
+    say("---")
+    say(
+        "Regenerate: `python -m cuda_mpi_gpu_cluster_programming_tpu.analysis "
+        "narrative --out docs/ANALYSIS.md` (after `... analysis ingest`)."
+    )
+    say("")
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text("\n".join(L))
+    print(f"wrote {out}")
+
+
 VIEWS = ("perf_runs", "best_runs", "run_stats", "summary_runs", "run_logs", "source_stats")
 
 
@@ -663,6 +928,11 @@ def make_parser() -> argparse.ArgumentParser:
     pr = sub.add_parser("report", help="markdown best-runs/stats report")
     pr.add_argument("--out", default="analysis_exports/best_runs_report.md")
     pr.add_argument("--baseline", default="V1 Serial")
+    pn = sub.add_parser(
+        "narrative", help="reference-vs-TPU analysis narrative (H7 artifact)"
+    )
+    pn.add_argument("--out", default="docs/ANALYSIS.md")
+    pn.add_argument("--baseline", default="V1 Serial")
     return p
 
 
@@ -686,6 +956,8 @@ def main(argv=None) -> int:
             cmd_export(conn, args.view, Path(args.out), args.fmt)
         elif args.cmd == "report":
             cmd_report(conn, Path(args.out), args.baseline)
+        elif args.cmd == "narrative":
+            cmd_narrative(conn, Path(args.out), args.baseline)
     finally:
         conn.close()
     return 0

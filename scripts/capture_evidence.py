@@ -180,11 +180,18 @@ def main() -> int:
             statuses,
         )
 
-    # 6. Report + exports.
+    # 6. Report + narrative + exports.
     run(
         "report",
         [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "report",
          "--out", "analysis_exports/best_runs_report.md"],
+        300,
+        statuses,
+    )
+    run(
+        "narrative",
+        [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "narrative",
+         "--out", "docs/ANALYSIS.md"],
         300,
         statuses,
     )
