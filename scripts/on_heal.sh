@@ -46,6 +46,24 @@ run_ab() {  # run_ab <outfile> <args...>: JSON rows -> outfile, all output -> LO
 run_ab perf/attention_ab_${FTS}.json --dtype bf16 --lengths 512,2048,8192
 run_ab perf/attention_ab_causal_${FTS}.json --dtype bf16 --lengths 512,2048 --causal
 
+say "conv variant A/B on the real chip: taps vs pairs x rowblock 8/16/32 (round-4 MXU-fill levers)"
+for conv in taps pairs; do
+    for rb in 8 16 32; do
+        for comp in bf16 fp32; do
+            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb timeout 600 \
+                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
+                | grep "completed in" \
+                | sed "s/^/conv=$conv rb=$rb $comp /" | tee -a "$LOG"
+        done
+    done
+done
+
+say "sharded comm/compute breakdown on the real chip (v2.2 shards=1, static plan + measured layers)"
+timeout 900 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+    --config v2.2_sharded --shards 1 --batch 32 --breakdown --repeats 20 2>&1 \
+    | grep -E "Layer|Comm|completed in" | tee -a "$LOG"
+
 say "ring/ulysses flash engines at shards=1 on the real chip (Mosaic lowering proof)"
 timeout 600 python - <<'EOF' 2>&1 | grep -v WARNING | tee -a "$LOG"
 import jax, numpy as np

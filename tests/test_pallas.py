@@ -224,3 +224,64 @@ def test_chain_variant_rejects_unknown(monkeypatch):
     monkeypatch.setenv("TPU_FRAMEWORK_CHAIN", "pad256")
     with pytest.raises(ValueError, match="TPU_FRAMEWORK_CHAIN"):
         pm.forward_blocks12_pallas(init_params_deterministic(), deterministic_input(batch=1))
+
+
+def test_conv_pairs_variant_matches_taps(monkeypatch):
+    """TPU_FRAMEWORK_CONV=pairs (adjacent-tap fusion, doubled contraction)
+    agrees with the tap-loop default to reduction-reorder tolerance, at
+    both an odd fq (stride 4, fq=3: pairs + leftover tap) and an even fq
+    (stride 1 f=4, fq=4: pairs only), and is deterministic within-variant."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 31, 31, 3))
+    w = jax.random.normal(jax.random.PRNGKey(1), (11, 11, 3, 16)) * 0.1
+    b = jnp.ones((16,)) * 0.1
+
+    monkeypatch.delenv("TPU_FRAMEWORK_CONV", raising=False)
+    taps = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "pairs")
+    pairs = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    pairs2 = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    np.testing.assert_allclose(pairs, taps, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(pairs, pairs2)  # deterministic
+
+    # even fq: stride 1, F=4 -> fq=4, two pairs per row, no leftover tap
+    w4 = jax.random.normal(jax.random.PRNGKey(2), (4, 4, 3, 8)) * 0.1
+    b4 = jnp.zeros((8,))
+    monkeypatch.delenv("TPU_FRAMEWORK_CONV", raising=False)
+    taps4 = np.asarray(conv2d_pallas(x, w4, b4, stride=1, padding=1))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "pairs")
+    pairs4 = np.asarray(conv2d_pallas(x, w4, b4, stride=1, padding=1))
+    np.testing.assert_allclose(pairs4, taps4, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_row_block_variant_bitwise(monkeypatch):
+    """TPU_FRAMEWORK_ROWBLOCK=16/32 changes only the grid tiling, not the
+    per-output accumulation order -> bitwise identical to the default 8."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 67, 67, 3))
+    w = jax.random.normal(jax.random.PRNGKey(6), (11, 11, 3, 16)) * 0.1
+    b = jnp.zeros((16,))
+    monkeypatch.delenv("TPU_FRAMEWORK_ROWBLOCK", raising=False)
+    r8 = np.asarray(conv2d_pallas(x, w, b, stride=4))
+    for rb in ("16", "32"):
+        monkeypatch.setenv("TPU_FRAMEWORK_ROWBLOCK", rb)
+        np.testing.assert_array_equal(np.asarray(conv2d_pallas(x, w, b, stride=4)), r8)
+
+
+def test_conv_variant_rejects_unknown(monkeypatch):
+    import pytest
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    x = jnp.ones((1, 15, 15, 3))
+    w = jnp.ones((3, 3, 3, 4))
+    b = jnp.zeros((4,))
+    monkeypatch.setenv("TPU_FRAMEWORK_ROWBLOCK", "12")
+    with pytest.raises(ValueError, match="TPU_FRAMEWORK_ROWBLOCK"):
+        conv2d_pallas(x, w, b, stride=1)
