@@ -182,9 +182,16 @@ def _build_forward_fp32(
         model_cfg = model_cfg or ALEXNET
         if exec_cfg.strategy == "single":
             if exec_cfg.tier == "pallas":
+                from .ops.pallas_kernels import KernelVariants
                 from .ops.pallas_model import forward_alexnet_pallas
 
-                return jax.jit(lambda p, x: forward_alexnet_pallas(p, x, model_cfg))
+                # Resolve lowering variants NOW: each build_forward call
+                # re-reads the env, so the A/B workflow is build-per-variant
+                # instead of the round-3 process-per-variant footgun.
+                kv = KernelVariants.resolve()
+                return jax.jit(
+                    lambda p, x: forward_alexnet_pallas(p, x, model_cfg, variants=kv)
+                )
             return jax.jit(lambda p, x: forward_alexnet(p, x, model_cfg))
         if exec_cfg.strategy in ("halo", "staged_halo"):
             from .models.alexnet_full import fc_head
@@ -205,9 +212,16 @@ def _build_forward_fp32(
     model_cfg = model_cfg or BLOCKS12
     if exec_cfg.strategy == "single":
         if exec_cfg.tier == "pallas":
-            from .ops.pallas_model import forward_blocks12_pallas
+            from .ops.pallas_kernels import KernelVariants
+            from .ops.pallas_model import _chain_variant, forward_blocks12_pallas
 
-            return jax.jit(lambda p, x: forward_blocks12_pallas(p, x, model_cfg))
+            kv = KernelVariants.resolve()  # eager: see alexnet_full branch
+            ch = _chain_variant()
+            return jax.jit(
+                lambda p, x: forward_blocks12_pallas(
+                    p, x, model_cfg, variants=kv, chain=ch
+                )
+            )
         return jax.jit(lambda p, x: forward_blocks12(p, x, model_cfg))
 
     if exec_cfg.strategy == "replicated":

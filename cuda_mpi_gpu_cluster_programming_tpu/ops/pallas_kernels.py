@@ -38,6 +38,8 @@ code path is unit-testable on the CPU mesh.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -81,13 +83,13 @@ def env_variant(env_name: str, default: str, allowed: tuple) -> str:
     TPU_FRAMEWORK_CONV / _POOL here and _CHAIN in pallas_model).
 
     Resolved at TRACE time — outside the per-op jit, so the variant
-    participates in the jit cache key. SCOPE CAVEAT: callers that wrap
-    the model in their OWN jit (configs.build_forward, the sharded tier)
-    bake the variant into that outer trace — flipping the env afterwards
-    does not retrace them. Set the variant before the first forward of a
-    process; the supported A/B workflow is one process per variant (the
-    run.py commands in docs/PALLAS_PERF.md), which tests/test_pallas.py
-    exercises for direct (un-jitted-caller) calls in-process."""
+    participates in the jit cache key. Build-time callers
+    (configs.build_forward, the sharded tier) resolve variants EAGERLY via
+    KernelVariants.resolve() and close over the result, so re-calling
+    build_forward after an env flip returns a function with the new
+    variant — the supported A/B workflow is build-per-variant (the round-3
+    process-per-variant footgun is gone; tests/test_configs.py holds
+    this)."""
     import os
 
     v = os.environ.get(env_name, "").strip().lower()
@@ -118,6 +120,24 @@ def _conv_variant() -> str:
 # measurable now that the sep2 pool freed VMEM headroom.
 def _row_block() -> int:
     return int(env_variant("TPU_FRAMEWORK_ROWBLOCK", "8", ("8", "16", "32")))
+
+
+class KernelVariants(NamedTuple):
+    """Resolved lowering-variant set — hashable, so it can ride jit static
+    args. ``resolve()`` reads the environment ONCE; build-time callers
+    (configs.build_forward, the sharded tier) resolve eagerly and close
+    over the result, which kills the round-3 footgun where flipping an env
+    var after the first forward silently kept the old variant inside the
+    outer jit's trace: every ``build_forward`` call now re-reads the env
+    and returns a fresh function carrying its variants explicitly."""
+
+    conv: str = "taps"
+    pool: str = "sep2"
+    row_block: int = 8  # keep in sync with _ROW_BLOCK below
+
+    @classmethod
+    def resolve(cls) -> "KernelVariants":
+        return cls(conv=_conv_variant(), pool=_pool_variant(), row_block=_row_block())
 
 
 def _mxu_precision(dtype):
@@ -268,13 +288,18 @@ def conv2d_pallas(
     padding_w: int | None = None,
     relu: bool = False,
     vma=None,
+    variant: str | None = None,
+    row_block: int | None = None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU) — thin wrapper resolving the
-    lowering variant from the environment before entering jit. ``vma``: mesh
-    axes the call varies over inside a check_vma=True shard_map (ops.vma)."""
+    lowering variant (explicit arg wins; env var otherwise) before entering
+    jit. ``vma``: mesh axes the call varies over inside a check_vma=True
+    shard_map (ops.vma)."""
     return _conv2d_pallas(
         x, w, b, stride=stride, padding=padding, padding_w=padding_w,
-        relu=relu, variant=_conv_variant(), row_block=_row_block(),
+        relu=relu,
+        variant=variant if variant is not None else _conv_variant(),
+        row_block=row_block if row_block is not None else _row_block(),
         vma=tuple(vma) if vma is not None else None,
     )
 
@@ -401,10 +426,16 @@ def _conv2d_pallas(
     return out
 
 
-def conv2d_pallas_hvalid(x, w, b, *, stride: int, padding_w: int, vma=None):
+def conv2d_pallas_hvalid(
+    x, w, b, *, stride: int, padding_w: int, vma=None,
+    variant: str | None = None, row_block: int | None = None,
+):
     """Sharded-tier entry: VALID on H (halo-provided), padded on W, fused ReLU
     is NOT applied here (the sharded pipeline masks then relus)."""
-    return conv2d_pallas(x, w, b, stride=stride, padding=0, padding_w=padding_w, vma=vma)
+    return conv2d_pallas(
+        x, w, b, stride=stride, padding=0, padding_w=padding_w, vma=vma,
+        variant=variant, row_block=row_block,
+    )
 
 
 def _pool_kernel(x_ref, o_ref, *, window: int, stride: int, ho: int, wo: int):
@@ -449,12 +480,13 @@ def _pool_variant() -> str:
     return env_variant("TPU_FRAMEWORK_POOL", "sep2", ("sep2", "phases"))
 
 
-def maxpool_pallas(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Array:
-    """Window max — thin wrapper resolving the lowering variant from the
-    environment before entering jit (same scope caveat as _conv_variant).
-    ``vma``: see ops.vma."""
+def maxpool_pallas(
+    x: jax.Array, *, window: int, stride: int, vma=None, variant: str | None = None
+) -> jax.Array:
+    """Window max — thin wrapper resolving the lowering variant (explicit
+    arg wins; env var otherwise) before entering jit. ``vma``: see ops.vma."""
     vma = tuple(vma) if vma is not None else None
-    if _pool_variant() == "phases":
+    if (variant if variant is not None else _pool_variant()) == "phases":
         return _maxpool_phases(x, window=window, stride=stride, vma=vma)
     return _maxpool_sep2(x, window=window, stride=stride, vma=vma)
 

@@ -44,32 +44,58 @@ def _pad_axis(a: jax.Array, axis: int, to: int) -> jax.Array:
     return jnp.pad(a, widths)
 
 
-def forward_blocks12_pallas(params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:
+def forward_blocks12_pallas(
+    params,
+    x: jax.Array,
+    cfg: Blocks12Config = BLOCKS12,
+    variants: pk.KernelVariants | None = None,
+    chain: str | None = None,
+) -> jax.Array:
+    """``variants``/``chain``: explicit lowering choices. Build-time callers
+    (configs.build_forward) resolve them eagerly and pass them in, so the
+    selection is part of the function they jit — re-building after an env
+    flip picks up the new variant (the round-3 footgun fix). Direct callers
+    may omit them (env/defaults resolve at trace time, as before)."""
+    v = variants if variants is not None else pk.KernelVariants.resolve()
     c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
-    pad128 = _chain_variant() == "pad128"
+    pad128 = (chain if chain is not None else _chain_variant()) == "pad128"
     w1, b1 = params["conv1"]["w"], params["conv1"]["b"]
     w2, b2 = params["conv2"]["w"], params["conv2"]["b"]
     if pad128:
         kp = -(-w1.shape[-1] // 128) * 128  # conv1 output channels -> 128
         w1, b1 = _pad_axis(w1, 3, kp), _pad_axis(b1, 0, kp)
         w2 = _pad_axis(w2, 2, kp)  # conv2 contraction axis: zero rows
-    x = pk.conv2d_pallas(x, w1, b1, stride=c1.stride, padding=c1.padding, relu=True)
-    x = pk.maxpool_pallas(x, window=p1.window, stride=p1.stride)
-    x = pk.conv2d_pallas(x, w2, b2, stride=c2.stride, padding=c2.padding, relu=True)
-    x = pk.maxpool_pallas(x, window=p2.window, stride=p2.stride)
+    conv = lambda x, w, b, s: pk.conv2d_pallas(  # noqa: E731
+        x, w, b, stride=s.stride, padding=s.padding, relu=True,
+        variant=v.conv, row_block=v.row_block,
+    )
+    pool = lambda x, s: pk.maxpool_pallas(  # noqa: E731
+        x, window=s.window, stride=s.stride, variant=v.pool
+    )
+    x = conv(x, w1, b1, c1)
+    x = pool(x, p1)
+    x = conv(x, w2, b2, c2)
+    x = pool(x, p2)
     x = pk.lrn_pallas(
         x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
     )
     return x
 
 
-def forward_alexnet_pallas(params, x: jax.Array, cfg=None) -> jax.Array:
+def forward_alexnet_pallas(
+    params,
+    x: jax.Array,
+    cfg=None,
+    variants: pk.KernelVariants | None = None,
+) -> jax.Array:
     """Full AlexNet on the Pallas tier: chain-driven spatial part (fused
-    conv+bias+ReLU launches), then the shared MXU-matmul FC head."""
+    conv+bias+ReLU launches), then the shared MXU-matmul FC head.
+    ``variants``: see :func:`forward_blocks12_pallas`."""
     from ..models.alexnet import ConvSpec, LrnSpec, PoolSpec
     from ..models.alexnet_full import ALEXNET, fc_head
 
     cfg = cfg or ALEXNET
+    v = variants if variants is not None else pk.KernelVariants.resolve()
     for name, spec in cfg.layer_chain():
         if isinstance(spec, ConvSpec):
             x = pk.conv2d_pallas(
@@ -79,9 +105,13 @@ def forward_alexnet_pallas(params, x: jax.Array, cfg=None) -> jax.Array:
                 stride=spec.stride,
                 padding=spec.padding,
                 relu=True,
+                variant=v.conv,
+                row_block=v.row_block,
             )
         elif isinstance(spec, PoolSpec):
-            x = pk.maxpool_pallas(x, window=spec.window, stride=spec.stride)
+            x = pk.maxpool_pallas(
+                x, window=spec.window, stride=spec.stride, variant=v.pool
+            )
         elif isinstance(spec, LrnSpec):
             x = pk.lrn_pallas(
                 x,

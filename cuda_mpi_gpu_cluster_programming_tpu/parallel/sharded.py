@@ -113,13 +113,21 @@ def build_sharded_forward(
     if tier == "pallas":
         import functools
 
-        from ..ops.pallas_kernels import conv2d_pallas_hvalid, maxpool_pallas
+        from ..ops.pallas_kernels import (
+            KernelVariants,
+            conv2d_pallas_hvalid,
+            maxpool_pallas,
+        )
 
         # vma-tagged out_shapes (ops.vma) let this shard_map keep
         # check_vma=True — previously the pallas tier forced the checker
-        # off for the whole body, halo ppermutes included.
-        conv_fn = functools.partial(conv2d_pallas_hvalid, vma=(AXIS,))
-        pool_fn = functools.partial(maxpool_pallas, vma=(AXIS,))
+        # off for the whole body, halo ppermutes included. Variants resolve
+        # eagerly at build time (same footgun fix as configs.build_forward).
+        kv = KernelVariants.resolve()
+        conv_fn = functools.partial(
+            conv2d_pallas_hvalid, vma=(AXIS,), variant=kv.conv, row_block=kv.row_block
+        )
+        pool_fn = functools.partial(maxpool_pallas, vma=(AXIS,), variant=kv.pool)
     else:
         conv_fn, pool_fn = _conv_hvalid, _pool_hvalid
 

@@ -39,3 +39,30 @@ def test_replicated_matches_single(n):
     single = build_forward(REGISTRY["v1_jit"])(params, x)
     repl = build_forward(REGISTRY["v2.1_replicated"], n_shards=n)(params, x)
     np.testing.assert_allclose(np.asarray(repl), np.asarray(single), rtol=1e-6)
+
+
+def test_build_forward_rebinds_variant_per_build(monkeypatch):
+    """The round-3 footgun fix: flipping TPU_FRAMEWORK_CONV and re-calling
+    build_forward must yield the new variant (previously the outer jit
+    silently kept the old trace; the supported A/B is build-per-variant)."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+
+    params = init_params_deterministic()
+    x = deterministic_input(batch=1)
+    monkeypatch.delenv("TPU_FRAMEWORK_CONV", raising=False)
+    f_taps = build_forward(REGISTRY["v3_pallas"])
+    out_taps = np.asarray(f_taps(params, x))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "pairs")
+    f_pairs = build_forward(REGISTRY["v3_pallas"])
+    out_pairs = np.asarray(f_pairs(params, x))
+    # Different lowering, same math (reduction-reorder tolerance).
+    np.testing.assert_allclose(out_pairs, out_taps, rtol=1e-5, atol=1e-5)
+    # The two builds really did trace different variants: their jitted
+    # callables are distinct functions with distinct closed-over variants.
+    assert f_taps is not f_pairs
