@@ -29,8 +29,8 @@ def make_parser() -> argparse.ArgumentParser:
         "--sp-engine",
         choices=["einsum", "flash"],
         default="einsum",
-        help="within-shard engine for ring/ulysses (ulysses+flash trains; "
-        "ring+flash is forward-only and rejected)",
+        help="within-shard engine for ring/ulysses (both train: ulysses via "
+        "the whole-sequence VJP, ring via the joint (out, lse) VJP)",
     )
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--period", type=int, default=8, help="repeating-pattern period")
@@ -93,11 +93,15 @@ def main(argv=None) -> int:
             )
         elif args.sp_engine == "flash":
             if args.attn == "ring":
-                err = (
-                    "--sp-engine flash with --attn ring is forward-only "
-                    "(per-hop LSE merge has no VJP) — training needs "
-                    "ulysses+flash or ring+einsum"
-                )
+                # ring+flash trains (joint (out, lse) VJP); its divisibility
+                # rule is per-shard: each hop's block is L/shards rows.
+                lb = args.seq_len // args.shards
+                if lb % flash_block(lb):
+                    err = (
+                        f"--sp-engine flash with --attn ring needs the "
+                        f"per-shard block (seq-len/shards = {lb}) to divide "
+                        f"by the flash block ({flash_block(lb)})"
+                    )
             else:  # ulysses: local flash attends the FULL sequence
                 err = flash_len_err("--sp-engine flash")
     if err is not None:

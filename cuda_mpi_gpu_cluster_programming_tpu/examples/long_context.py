@@ -49,8 +49,9 @@ def make_parser() -> argparse.ArgumentParser:
         choices=["einsum", "flash"],
         default="einsum",
         help="within-shard engine for ring/ulysses: einsum = XLA score "
-        "blocks (differentiable); flash = Pallas flash kernel per "
-        "hop/shard (O(block) memory; ring's flash engine is forward-only)",
+        "blocks; flash = Pallas flash kernel per hop/shard (O(block) "
+        "memory) — both differentiable (ring+flash via the joint "
+        "(out, lse) VJP)",
     )
     p.add_argument(
         "--verify",

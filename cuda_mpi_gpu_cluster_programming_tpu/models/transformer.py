@@ -50,21 +50,17 @@ class TransformerConfig:
     # tensor-sharded over (shard_lm_params_tp's axis); ring/ulysses then
     # name it in their shard_map specs so CP and TP compose in one step.
     sp_head_axis: Optional[str] = None
-    # Within-shard engine for ring/ulysses: "einsum" (XLA score blocks,
-    # differentiable everywhere) or "flash" (Pallas kernel). Ulysses+flash
-    # remains differentiable (whole-sequence VJP); ring+flash is
-    # forward-only and rejected here because the LM exists to train.
+    # Within-shard engine for ring/ulysses: "einsum" (XLA score blocks) or
+    # "flash" (Pallas kernel). BOTH compositions train: ulysses+flash via
+    # the whole-sequence VJP, ring+flash via the joint (out, lse) VJP
+    # (round 4 — the lse cotangent shifts the FA-2 backward's delta term,
+    # ops/flash_attention._flash_backward), so every sp x engine cell is
+    # differentiable with the flash cells at O(L) memory per shard.
     attn_engine: str = "einsum"
 
     def __post_init__(self):
         if self.attn_engine not in ("einsum", "flash"):
             raise ValueError(f"attn_engine must be einsum|flash, got {self.attn_engine!r}")
-        if self.attn_engine == "flash" and self.attn_impl == "ring":
-            raise ValueError(
-                "attn_engine='flash' with attn_impl='ring' is forward-only "
-                "(per-hop LSE merge has no VJP) — the LM trains, so use "
-                "ulysses+flash or ring+einsum"
-            )
     # Mixture-of-experts FFN (0 = dense). Top-1 (Switch) routing with a
     # capacity limit; the expert axis is what EP shards (see moe_ffn).
     n_experts: int = 0

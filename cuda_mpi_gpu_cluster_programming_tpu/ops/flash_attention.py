@@ -253,7 +253,7 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k, vma=None):
+def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k, vma=None, lse_grad=None):
     b, l, h, d = q.shape
     bq = min(block_q, l)
     bk = min(block_k, l)
@@ -271,6 +271,14 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k, vma=None)
     delta = jnp.sum(
         gt.astype(jnp.float32) * dot.astype(jnp.float32), axis=-1, keepdims=True
     ).swapaxes(-1, -2)
+    if lse_grad is not None:
+        # Joint (out, lse) VJP: lse_i = logsumexp(s_i) has d(lse_i)/d(s_ij)
+        # = p_ij, so an lse cotangent g_lse adds p_ij * g_lse_i to dS —
+        # algebraically dS_ij = p_ij (dP_ij - (delta_i - g_lse_i)), i.e.
+        # the SAME kernels with delta shifted by -g_lse. dV is untouched
+        # (lse does not depend on V). This one-line shift is what makes
+        # the ring engine's per-hop LSE merge differentiable end to end.
+        delta = delta - lse_grad.astype(jnp.float32)[:, :, None, :]
 
     qb = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     kb = lambda bi, hi, qi, ki: (bi, hi, ki, 0)
@@ -405,7 +413,7 @@ def flash_attention_with_lse(
     block_k: int = 128,
     vma=None,
 ) -> tuple:
-    """Forward-only fused attention returning ``(out, lse)``.
+    """Fused attention returning ``(out, lse)``, differentiable in both.
 
     ``out``: (B, L, H, D) normalized attention; ``lse``: (B, H, L) per-row
     log-sum-exp of the scaled scores. Two normalized partials over disjoint
@@ -415,11 +423,13 @@ def flash_attention_with_lse(
         out  = exp(lse1 - lse) * out1 + exp(lse2 - lse) * out2
 
     which is what the ring-attention flash engine does per hop
-    (parallel.sequence_parallel). NOT differentiable — differentiating
-    raises NotImplementedError with the supported alternatives (the config
-    layer rejects ring+flash training up front; this guard gives library
-    users calling jax.grad directly the same clean message instead of an
-    opaque Pallas autodiff error). ``vma``: see :func:`flash_attention`.
+    (parallel.sequence_parallel). DIFFERENTIABLE, jointly in both outputs:
+    the custom VJP accepts cotangents for ``out`` AND ``lse`` (the lse
+    cotangent shifts the FA-2 backward's delta term by -g_lse — see
+    ``_flash_backward``), which is exactly what flowing gradients through
+    the ring engine's per-hop LSE merge requires. Memory stays O(L)
+    (blockwise recompute, no (L, L) residency). ``vma``: see
+    :func:`flash_attention`.
     """
     vma = tuple(vma) if vma is not None else None
     return _flash_lse(causal, block_q, block_k, vma, q, k, v)
@@ -435,14 +445,21 @@ def _flash_lse(causal, block_q, block_k, vma, q, k, v):
 
 
 def _flash_lse_fwd(causal, block_q, block_k, vma, q, k, v):
-    return _flash_lse(causal, block_q, block_k, vma, q, k, v), None
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        return_lse=True, vma=vma,
+    )
+    # Residual lse keeps the kernels' (B, H, 1, L) layout; the primal
+    # output exposes (B, H, L).
+    return (out, lse[:, :, 0, :]), (q, k, v, out, lse)
 
 
 def _flash_lse_bwd(causal, block_q, block_k, vma, res, g):
-    raise NotImplementedError(
-        "flash_attention_with_lse is forward-only: the per-hop LSE merge has "
-        "no VJP. For training use ulysses+flash (whole-sequence VJP) or "
-        "ring with engine='einsum'."
+    q, k, v, out, lse = res
+    g_o, g_lse = g
+    return _flash_backward(
+        q, k, v, out, lse, g_o.astype(q.dtype), causal=causal,
+        block_q=block_q, block_k=block_k, vma=vma, lse_grad=g_lse,
     )
 
 

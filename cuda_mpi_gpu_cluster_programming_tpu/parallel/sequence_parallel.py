@@ -118,6 +118,11 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causa
     hops split three ways on the block's global position: src < me = full
     attention, src == me = in-block causal, src > me = skipped (the flash
     kernel's causal mask is block-local, so the split is done here).
+
+    Differentiable end to end: each hop's kernel call carries the joint
+    (out, lse) VJP, the LSE-merge arithmetic is plain XLA, and the
+    fori_loop/ppermute/switch all have transpose rules — so this body
+    needs no custom backward of its own.
     """
     from ..ops.flash_attention import flash_attention_with_lse
 
@@ -228,11 +233,14 @@ def ring_attention(
     caller's ``mesh`` must contain both axes.
 
     ``engine``: ``"einsum"`` (default) materializes each hop's (Lb, Lb)
-    score block with XLA ops — differentiable, the training path.
-    ``"flash"`` runs the Pallas flash kernel per hop and merges partials by
-    LSE — O(Lb·D) within-chip memory for long per-chip blocks, forward
-    only (the flash VJP covers the whole-sequence call, not the per-hop
-    LSE-merged composition).
+    score block with XLA ops — differentiable. ``"flash"`` runs the Pallas
+    flash kernel per hop and merges partials by LSE — O(Lb·D) within-chip
+    memory for long per-chip blocks, and ALSO differentiable: the kernel's
+    joint (out, lse) VJP (ops.flash_attention) lets gradients flow through
+    the per-hop merge, so autodiff reverses the whole ring (ppermutes
+    transpose to reversed permutations, the merge arithmetic is plain XLA).
+    Gradient-vs-oracle equivalence is tested at n∈{2,4}, causal and not
+    (tests/test_flash_attention.py).
     """
     b, l, h, d = q.shape
     if l % n_shards != 0:
@@ -283,9 +291,10 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str, vary_a
 
     After the reshard each shard holds the FULL sequence for its local
     heads, so ``engine='flash'`` is just :func:`ops.flash_attention` on
-    that call — the whole-sequence signature its custom VJP covers, hence
-    (unlike the ring's per-hop LSE merge) it remains differentiable while
-    dropping the (L, L) score residency of the einsum path.
+    that call — the whole-sequence signature with the standard flash VJP
+    (the ring engine instead differentiates through its per-hop joint
+    (out, lse) VJP) — dropping the (L, L) score residency of the einsum
+    path.
     """
     if engine == "flash":
         from ..ops.flash_attention import flash_attention

@@ -89,6 +89,11 @@ for shape in [(32, 55, 55, 96), (128, 27, 27, 256)]:
     print("relu grid ok", shape, jax.devices()[0].platform)
 EOF
 
+say "ring+flash LM training on the real chip (joint (out,lse) VJP backward lowering proof)"
+timeout 900 python -m cuda_mpi_gpu_cluster_programming_tpu.examples.lm \
+    --steps 10 --attn ring --sp-engine flash --shards 1 --seq-len 256 \
+    --target-loss 999 2>&1 | grep -vE "WARNING" | tail -4 | tee -a "$LOG"
+
 say "short AlexNet classification training run (training evidence row)"
 timeout 900 python -m cuda_mpi_gpu_cluster_programming_tpu.train --steps 20 --batch 32 2>&1 \
     | grep -vE "WARNING" | tail -6 | tee -a "$LOG"

@@ -94,11 +94,38 @@ class TestTraining:
 
 
 def test_attn_engine_validation():
-    """ulysses+flash trains (whole-sequence VJP); ring+flash is
-    forward-only and must be rejected at config time — the LM exists to
-    train."""
+    """Both flash compositions are accepted since the joint (out, lse) VJP
+    landed (round 4): ulysses+flash (whole-sequence VJP) and ring+flash
+    (per-hop VJP) train; only unknown engines are rejected."""
     TransformerConfig(attn_impl="ulysses", attn_engine="flash")  # fine
-    with pytest.raises(ValueError, match="forward-only"):
-        TransformerConfig(attn_impl="ring", attn_engine="flash")
+    TransformerConfig(attn_impl="ring", attn_engine="flash")  # trains too now
     with pytest.raises(ValueError, match="attn_engine"):
         TransformerConfig(attn_engine="warp")
+
+
+def test_ring_flash_lm_trains():
+    """An LM with ring+flash attention takes a training step and matches
+    the single-device loss — the capability the old config guard denied."""
+    import jax
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
+        init_transformer,
+        lm_loss,
+        make_lm_train_step,
+    )
+
+    cfg = TransformerConfig(
+        d_model=32, n_heads=2, n_layers=2, d_ff=64, max_len=64,
+        attn_impl="ring", attn_engine="flash", sp_shards=4,
+    )
+    ref_cfg = TransformerConfig(d_model=32, n_heads=2, n_layers=2, d_ff=64, max_len=64)
+    key = jax.random.PRNGKey(0)
+    params = init_transformer(key, cfg)
+    tokens = jax.random.randint(key, (2, 33), 0, cfg.vocab)  # shifted len 32 = 4*8
+    opt_init, step = make_lm_train_step(cfg, lr=1e-3)
+    p1, _, loss = step(params, opt_init(params), tokens)
+    jax.block_until_ready(p1)
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(
+        float(loss), float(lm_loss(params, tokens, ref_cfg)), rtol=1e-3
+    )
