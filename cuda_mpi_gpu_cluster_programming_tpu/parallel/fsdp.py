@@ -1,0 +1,92 @@
+"""FSDP / ZeRO-style parameter sharding over a ``dp`` mesh axis.
+
+Plain data parallelism replicates every parameter (and its optimizer
+state) on every device — the memory wall ZeRO/FSDP exists to break. The
+GSPMD formulation (the scaling-book recipe, same pattern as
+``parallel/expert.py``): annotate each parameter leaf as sharded along
+one of its own axes over the SAME mesh axis the batch is sharded over,
+and let XLA insert the collectives — parameters are all-gathered just
+before the layers that use them (forward and again in the recompute-free
+backward), gradients reduce-scatter back to their owning shard, and the
+optimizer update runs on 1/n of every tensor per device. Parameter,
+gradient, and optimizer-state memory all scale as 1/n_dp while the math
+stays exactly data parallelism.
+
+The reference has no analogue (its model is replicated on every rank —
+V2.1's broadcast-all is the ANTI-pattern this module removes); this is
+the TPU-native completion of the dp column of the parallelism zoo:
+dp(replicated) / fsdp(dp-sharded) / sp / tp / pp / ep.
+
+No new train-step code is needed: ``models.transformer.make_lm_train_step``
+jits the same loss, and GSPMD propagates the param shardings through
+grads and optimizer state (the optax state pytree mirrors the param
+tree, so its leaves inherit the same placement) — placement IS the
+implementation, exactly as in expert parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+
+Params = Any
+
+
+def fsdp_spec(shape, dp: int, axis_name: str = "dp") -> P:
+    """PartitionSpec sharding the LARGEST dp-divisible dim of ``shape``.
+
+    Largest-dim choice minimizes per-shard padding waste and matches how
+    FSDP implementations flatten-and-split; leaves with no divisible dim
+    (tiny biases, scalars) stay replicated — their memory is negligible,
+    which is why real FSDP wraps them with the nearest block.
+    """
+    if not shape:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    for i in order:
+        if shape[i] % dp == 0 and shape[i] >= dp:
+            return P(*[axis_name if j == i else None for j in range(len(shape))])
+    return P()
+
+
+def shard_params_fsdp(
+    params: Params,
+    mesh: Optional[Mesh] = None,
+    *,
+    n_shards: int = 0,
+    axis_name: str = "dp",
+) -> Params:
+    """device_put every parameter leaf sharded per :func:`fsdp_spec`."""
+    if mesh is None:
+        mesh = make_mesh(n_shards, axis_name=axis_name)
+    dp = mesh.shape[axis_name]
+
+    def put(leaf):
+        return jax.device_put(
+            leaf, NamedSharding(mesh, fsdp_spec(leaf.shape, dp, axis_name))
+        )
+
+    return jax.tree.map(put, params)
+
+
+def sharded_fraction(params: Params, axis_name: str = "dp") -> float:
+    """Fraction of parameter BYTES whose leaf is actually sharded over
+    ``axis_name`` — the honest memory-scaling number (replicated stragglers
+    counted against it). Used by tests to assert FSDP placement engaged."""
+    total = 0
+    sharded = 0
+    for leaf in jax.tree.leaves(params):
+        n = leaf.size * leaf.dtype.itemsize
+        total += n
+        spec = getattr(leaf.sharding, "spec", None)
+        if spec is not None and any(
+            (s == axis_name or (isinstance(s, tuple) and axis_name in s))
+            for s in spec
+            if s is not None
+        ):
+            sharded += n
+    return sharded / total if total else 0.0
