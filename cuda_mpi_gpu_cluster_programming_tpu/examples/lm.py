@@ -44,6 +44,18 @@ def make_parser() -> argparse.ArgumentParser:
         help="pipeline stages for the decoder stack (0 = no pipeline)",
     )
     p.add_argument("--microbatches", type=int, default=2, help="pp microbatches")
+    p.add_argument(
+        "--fsdp", action="store_true",
+        help="ZeRO/FSDP: shard params+optimizer over all devices as 'dp' "
+        "and split the batch over the same axis (single-axis CLI runs: "
+        "not combinable with ring/ulysses, --pp-stages, or ep-sharded "
+        "--experts; mesh compositions live in the library/tests)",
+    )
+    p.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize each decoder block (jax.checkpoint): activation "
+        "memory O(1) in depth at ~1 extra forward of FLOPs",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1.0, help="PASS threshold")
     p.add_argument("--save-params", help="save trained params to this .npz")
@@ -125,6 +137,20 @@ def main(argv=None) -> int:
         if err is not None:
             print(err, file=sys.stderr)
             return 2
+    # FSDP argument guards — one mesh axis per CLI run (clean rc=2 policy).
+    if args.fsdp:
+        n_dev = jax.device_count()
+        if args.attn in ("ring", "ulysses") and args.shards > 1:
+            err = "--fsdp is not combinable with ring/ulysses sharding in the CLI"
+        elif args.pp_stages:
+            err = "--fsdp is not combinable with --pp-stages"
+        elif args.experts and n_dev > 1 and args.experts % n_dev == 0:
+            err = "--fsdp is not combinable with ep-sharded --experts"
+        elif args.batch % n_dev:
+            err = f"--fsdp needs --batch divisible by {n_dev} device(s)"
+        if err is not None:
+            print(err, file=sys.stderr)
+            return 2
     cfg = dataclasses.replace(
         TINY_LM,
         attn_impl=args.attn,
@@ -132,6 +158,7 @@ def main(argv=None) -> int:
         sp_shards=args.shards,
         max_len=max(TINY_LM.max_len, args.seq_len),
         n_experts=args.experts,
+        remat=args.remat,
     )
     if args.resume:
         from ..utils.checkpoint import load_params_npz
@@ -185,8 +212,26 @@ def main(argv=None) -> int:
     base = jnp.arange(args.seq_len + 1, dtype=jnp.int32) % args.period
     tokens = jnp.tile(base[None], (args.batch, 1))
 
-    extras = (f", experts={cfg.n_experts}{ep_note}" if cfg.n_experts else "") + (
-        f", pp={args.pp_stages}x{args.microbatches}mb" if args.pp_stages else ""
+    fsdp_note = ""
+    if args.fsdp:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.fsdp import shard_params_fsdp, sharded_fraction
+        from ..parallel.mesh import make_mesh
+
+        fsdp_mesh = make_mesh(jax.device_count(), axis_name="dp")
+        params = shard_params_fsdp(params, fsdp_mesh)
+        tokens = jax.device_put(tokens, NamedSharding(fsdp_mesh, P("dp")))
+        fsdp_note = (
+            f", fsdp over {jax.device_count()} devices "
+            f"({sharded_fraction(params):.0%} of param bytes sharded)"
+        )
+
+    extras = (
+        (f", experts={cfg.n_experts}{ep_note}" if cfg.n_experts else "")
+        + (f", pp={args.pp_stages}x{args.microbatches}mb" if args.pp_stages else "")
+        + fsdp_note
+        + (", remat" if args.remat else "")
     )
     print(
         f"--- Byte-LM training [{args.attn}] (shards={args.shards}, "

@@ -65,6 +65,13 @@ class TransformerConfig:
     # capacity limit; the expert axis is what EP shards (see moe_ffn).
     n_experts: int = 0
     capacity_factor: float = 1.25
+    # Rematerialization: wrap each decoder block in jax.checkpoint so the
+    # backward recomputes block activations instead of storing them —
+    # activation memory drops from O(n_layers * B * L * D) to O(B * L * D)
+    # at ~1 extra forward of FLOPs. The long-context memory lever that
+    # composes with ring/ulysses (which shard L) and flash (which keeps
+    # attention O(L)): remat removes the remaining per-layer residuals.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -249,8 +256,11 @@ def forward_lm(
         raise ValueError(f"sequence length {l} exceeds max_len {cfg.max_len}")
     x = params["embed"][tokens] + params["pos"][:l][None]
     aux_total = jnp.float32(0.0)
+    block = lambda lyr, h: decoder_block(lyr, h, cfg=cfg, mesh=mesh, return_aux=True)  # noqa: E731
+    if cfg.remat:
+        block = jax.checkpoint(block)
     for layer in params["layers"]:
-        x, aux = decoder_block(layer, x, cfg=cfg, mesh=mesh, return_aux=True)
+        x, aux = block(layer, x)
         aux_total = aux_total + aux
     x = rmsnorm(x, params["final_norm"]["g"])
     logits = x @ params["embed"].T  # weight-tied LM head

@@ -94,3 +94,21 @@ def test_lm_resume_structural_mismatch_rc2(tmp_path, capsys):
     err = capsys.readouterr().err
     assert rc == 2
     assert "does not match this run's config" in err
+
+
+def test_lm_fsdp_remat_converges(capsys):
+    """--fsdp (ZeRO param sharding over all 8 devices) + --remat trains to
+    the target through the CLI and reports the sharded byte fraction."""
+    rc = lm.main(
+        ["--steps", "40", "--fsdp", "--remat", "--seq-len", "64", "--batch", "8"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-> PASSED" in out
+    assert "fsdp over 8 devices" in out and "remat" in out
+
+
+def test_lm_fsdp_guards(capsys):
+    assert lm.main(["--fsdp", "--attn", "ring", "--shards", "4"]) == 2
+    assert lm.main(["--fsdp", "--pp-stages", "2"]) == 2
+    assert lm.main(["--fsdp", "--batch", "3"]) == 2  # 3 % 8 devices

@@ -129,3 +129,37 @@ def test_ring_flash_lm_trains():
     np.testing.assert_allclose(
         float(loss), float(lm_loss(params, tokens, ref_cfg)), rtol=1e-3
     )
+
+
+def test_remat_same_loss_and_grads():
+    """cfg.remat wraps each block in jax.checkpoint: the jaxpr gains remat
+    regions, while loss and gradients are unchanged."""
+    import jax
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
+        init_transformer,
+        lm_loss,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.breakdown import (
+        count_primitive,
+    )
+
+    base = TransformerConfig(d_model=32, n_heads=2, n_layers=3, d_ff=64, max_len=32)
+    rcfg = TransformerConfig(
+        d_model=32, n_heads=2, n_layers=3, d_ff=64, max_len=32, remat=True
+    )
+    key = jax.random.PRNGKey(0)
+    params = init_transformer(key, base)
+    tokens = jax.random.randint(key, (2, 17), 0, base.vocab)
+
+    g_base = jax.grad(lambda p: lm_loss(p, tokens, base))(params)
+    g_remat = jax.grad(lambda p: lm_loss(p, tokens, rcfg))(params)
+    np.testing.assert_allclose(
+        float(lm_loss(params, tokens, rcfg)), float(lm_loss(params, tokens, base)),
+        rtol=1e-6,
+    )
+    for a, b in zip(jax.tree.leaves(g_remat), jax.tree.leaves(g_base)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    # remat actually engaged: checkpoint regions appear in the grad jaxpr
+    jaxpr = jax.make_jaxpr(lambda p: jax.grad(lambda q: lm_loss(q, tokens, rcfg))(p))(params)
+    assert count_primitive(jaxpr, "remat") + count_primitive(jaxpr, "remat2") > 0
