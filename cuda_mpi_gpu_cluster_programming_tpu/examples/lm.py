@@ -56,6 +56,13 @@ def make_parser() -> argparse.ArgumentParser:
         help="rematerialize each decoder block (jax.checkpoint): activation "
         "memory O(1) in depth at ~1 extra forward of FLOPs",
     )
+    p.add_argument(
+        "--compute", choices=["fp32", "bf16"], default="fp32",
+        help="bf16 = mixed precision: forward/backward in bfloat16 (native "
+        "MXU), fp32 master weights + optimizer",
+    )
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient-accumulation microbatches per optimizer step")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1.0, help="PASS threshold")
     p.add_argument("--save-params", help="save trained params to this .npz")
@@ -232,6 +239,8 @@ def main(argv=None) -> int:
         + (f", pp={args.pp_stages}x{args.microbatches}mb" if args.pp_stages else "")
         + fsdp_note
         + (", remat" if args.remat else "")
+        + (", bf16-mixed" if args.compute == "bf16" else "")
+        + (f", accum={args.accum_steps}" if args.accum_steps > 1 else "")
     )
     print(
         f"--- Byte-LM training [{args.attn}] (shards={args.shards}, "
@@ -240,6 +249,18 @@ def main(argv=None) -> int:
     )
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
 
+    if args.accum_steps < 1 or args.batch % args.accum_steps:
+        print(
+            f"--accum-steps must divide --batch "
+            f"({args.batch} % {args.accum_steps} != 0)",
+            file=sys.stderr,
+        )
+        return 2
+    step_kw = dict(
+        lr=args.lr,
+        accum_steps=args.accum_steps,
+        compute_dtype=jnp.bfloat16 if args.compute == "bf16" else None,
+    )
     if args.pp_stages:
         # Pipeline the decoder stack: same loss through the shared step
         # factory, staged GPipe schedule inside the loss.
@@ -249,14 +270,14 @@ def main(argv=None) -> int:
         pp_mesh = make_mesh(args.pp_stages, axis_name="pp")
         opt_init, step = make_lm_train_step(
             cfg,
-            lr=args.lr,
             loss_fn=lambda p, t: pipeline_lm_loss(
                 p, t, cfg, n_stages=args.pp_stages,
                 n_microbatches=args.microbatches, mesh=pp_mesh,
             ),
+            **step_kw,
         )
     else:
-        opt_init, step = make_lm_train_step(cfg, lr=args.lr)
+        opt_init, step = make_lm_train_step(cfg, **step_kw)
     opt_state = opt_init(params)
     first = last = None
     t0 = time.perf_counter()

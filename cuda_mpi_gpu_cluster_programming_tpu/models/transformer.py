@@ -298,6 +298,8 @@ def make_lm_train_step(
     optimizer=None,
     lr: float = 1e-3,
     loss_fn=None,
+    accum_steps: int = 1,
+    compute_dtype=None,
 ):
     """(init_fn, step_fn) for LM training; any optax optimizer (default adam).
 
@@ -306,16 +308,67 @@ def make_lm_train_step(
     the "sp" sequence axis inside the forward itself. ``loss_fn(params,
     tokens)`` overrides the default ``lm_loss`` — the single step factory
     serves the plain, expert-parallel, and pipeline-parallel paths.
+
+    ``accum_steps > 1``: gradient accumulation — the batch is split into
+    that many microbatches and their gradients averaged inside ONE
+    ``lax.scan`` before a single optimizer update. Mathematically
+    identical to the full-batch step (equal microbatch sizes make
+    mean-of-means the global mean) while activation memory drops to one
+    microbatch's worth — the optimizer-step-preserving way to grow the
+    effective batch past memory, composing with remat/FSDP/sp.
+
+    ``compute_dtype=jnp.bfloat16``: mixed precision with fp32 MASTER
+    weights — the forward/backward run with params cast to bf16 (matmuls
+    hit the MXU natively; the cast's VJP returns fp32 cotangents), while
+    the stored params and the optimizer update stay fp32, so tiny adam
+    updates are never rounded away step over step. The loss itself is
+    already computed in fp32 (``lm_loss`` upcasts logits).
     """
     import optax
 
     opt = optimizer if optimizer is not None else optax.adam(lr)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if loss_fn is None:
         loss_fn = lambda p, t: lm_loss(p, t, cfg, mesh)  # noqa: E731
+    if compute_dtype is not None:
+        inner_loss = loss_fn
+
+        def loss_fn(p, t):  # noqa: F811 — deliberate wrap
+            pc = jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                p,
+            )
+            return inner_loss(pc, t)
 
     @jax.jit
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum_steps}"
+                )
+            micro = tokens.reshape(accum_steps, b // accum_steps, *tokens.shape[1:])
+
+            def acc(carry, mb):
+                loss_sum, grad_sum = carry
+                l_mb, g_mb = jax.value_and_grad(loss_fn)(params, mb)
+                return (
+                    loss_sum + l_mb,
+                    jax.tree.map(jnp.add, grad_sum, g_mb),
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                acc, (jnp.float32(0.0), zeros), micro
+            )
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
         updates, new_opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state, loss
 

@@ -112,3 +112,17 @@ def test_lm_fsdp_guards(capsys):
     assert lm.main(["--fsdp", "--attn", "ring", "--shards", "4"]) == 2
     assert lm.main(["--fsdp", "--pp-stages", "2"]) == 2
     assert lm.main(["--fsdp", "--batch", "3"]) == 2  # 3 % 8 devices
+
+
+def test_lm_bf16_accum_converges(capsys):
+    """--compute bf16 (mixed precision) + --accum-steps 2 trains to the
+    target; indivisible accum rejected rc=2."""
+    rc = lm.main(
+        ["--steps", "40", "--compute", "bf16", "--accum-steps", "2",
+         "--batch", "4", "--seq-len", "64"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-> PASSED" in out
+    assert "bf16-mixed" in out and "accum=2" in out
+    assert lm.main(["--accum-steps", "3", "--batch", "4"]) == 2
