@@ -63,6 +63,11 @@ def make_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient-accumulation microbatches per optimizer step")
+    p.add_argument(
+        "--generate", type=int, default=0, metavar="N",
+        help="after training, greedy-decode N tokens from the first 16 of "
+        "the pattern via the KV-cache path and verify the continuation",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1.0, help="PASS threshold")
     p.add_argument("--save-params", help="save trained params to this .npz")
@@ -249,13 +254,38 @@ def main(argv=None) -> int:
     )
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
 
-    if args.accum_steps < 1 or args.batch % args.accum_steps:
+    if args.accum_steps < 1:
+        print(f"--accum-steps must be >= 1, got {args.accum_steps}", file=sys.stderr)
+        return 2
+    if args.batch % args.accum_steps:
         print(
             f"--accum-steps must divide --batch "
             f"({args.batch} % {args.accum_steps} != 0)",
             file=sys.stderr,
         )
         return 2
+    if args.pp_stages and (args.batch // args.accum_steps) % args.microbatches:
+        # The scan hands batch/accum rows to the pipeline loss, which then
+        # splits by --microbatches — guard the composition here or it
+        # surfaces as a raw trace-time ValueError.
+        print(
+            f"--accum-steps {args.accum_steps} with --pp-stages leaves "
+            f"microbatches of {args.batch // args.accum_steps} rows, not "
+            f"divisible by --microbatches {args.microbatches}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.generate > 0 and not cfg.n_experts:
+        # Pre-work guard (clean rc=2 policy): don't train for minutes and
+        # then reject the generation length.
+        plen = min(16, args.seq_len)
+        if plen + args.generate > cfg.max_len:
+            print(
+                f"--generate {args.generate} exceeds max_len "
+                f"{cfg.max_len} - prompt {plen}",
+                file=sys.stderr,
+            )
+            return 2
     step_kw = dict(
         lr=args.lr,
         accum_steps=args.accum_steps,
@@ -301,6 +331,20 @@ def main(argv=None) -> int:
         f"Verification: loss {first:.4f} -> {last:.4f} "
         f"(target {args.target_loss}) -> {'PASSED' if ok else 'FAILED'}"
     )
+    if args.generate > 0:
+        if cfg.n_experts:
+            print("--generate skipped: KV-cache decode is dense-only", file=sys.stderr)
+        else:
+            from ..models.transformer import generate as lm_generate
+
+            plen = min(16, args.seq_len)  # length pre-validated above
+            seq = lm_generate(params, tokens[:1, :plen], cfg, steps=args.generate)
+            got = [int(v) for v in seq[0, plen:]]
+            want = [int((plen + i) % args.period) for i in range(args.generate)]
+            gen_ok = got == want
+            print(f"Generated {args.generate} tokens: {got[:24]}")
+            print(f"Generation continuation: {'PASSED' if gen_ok else 'FAILED'}")
+            ok = ok and gen_ok
     return 0 if ok else 1
 
 

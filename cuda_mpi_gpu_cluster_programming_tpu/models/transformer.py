@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import attention
+from ..ops.attention import NEG_INF, attention
 
 Params = Dict[str, Any]
 
@@ -373,3 +373,140 @@ def make_lm_train_step(
         return optax.apply_updates(params, updates), new_opt_state, loss
 
     return opt.init, step
+
+
+# ---------------------------------------------------------------------------
+# Inference: KV-cache incremental decode + autoregressive generation
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
+    """Per-layer (B, max_len, H, Dh) K/V buffers for incremental decode —
+    static shapes (XLA-friendly), filled in place by dynamic_update_slice
+    as positions arrive. O(max_len * D) per layer instead of recomputing
+    the full prefix every token (O(L^2) -> O(L) per generated token)."""
+    shape = (batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def _decode_block(layer: Params, x: jax.Array, cache, pos, cfg: TransformerConfig):
+    """One pre-norm decoder block for ONE token (B, 1, D) at ``pos``.
+
+    Mirrors ``decoder_block`` exactly (same rmsnorm/residual structure,
+    fp32 softmax statistics like ops.attention) but attends q against the
+    cached K/V prefix instead of the full sequence — the positions > pos
+    are masked, so the zero-initialized tail of the cache never
+    contributes. Dense FFN only (MoE capacity depends on the full token
+    count, so an incremental MoE decode would not match training routing).
+    """
+    b = x.shape[0]
+    h = rmsnorm(x, layer["attn_norm"]["g"])
+    qkv = jnp.einsum("bld,dse->blse", h, layer["wqkv"])
+    shape = (b, 1, cfg.n_heads, cfg.head_dim)
+    q = qkv[:, :, 0].reshape(shape)
+    k = qkv[:, :, 1].reshape(shape)
+    v = qkv[:, :, 2].reshape(shape)
+    ck = jax.lax.dynamic_update_slice_in_dim(
+        cache["k"], k.astype(cache["k"].dtype), pos, axis=1
+    )
+    cv = jax.lax.dynamic_update_slice_in_dim(
+        cache["v"], v.astype(cache["v"].dtype), pos, axis=1
+    )
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    s = (
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32))
+        * scale
+    )
+    mask = (jnp.arange(cfg.max_len) <= pos)[None, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32)).astype(x.dtype)
+    x = x + out.reshape(b, 1, cfg.d_model) @ layer["wo"]
+    h2 = rmsnorm(x, layer["mlp_norm"]["g"])
+    x = x + jax.nn.gelu(h2 @ layer["w_up"]) @ layer["w_down"]
+    return x, {"k": ck, "v": cv}
+
+
+def _decode_scan(params, prompt, cfg, steps, temperature, key, collect_logits=False):
+    b, plen = prompt.shape
+    total = plen + steps
+    if total > cfg.max_len:
+        raise ValueError(f"prompt + steps = {total} exceeds max_len {cfg.max_len}")
+    if cfg.n_experts:
+        raise ValueError(
+            "KV-cache decode supports dense FFN configs only (MoE capacity "
+            "routing depends on the full token count)"
+        )
+    caches = init_kv_cache(cfg, b, params["embed"].dtype)
+    padded = jnp.pad(prompt, ((0, 0), (0, steps)))
+
+    def step(carry, t):
+        tok, caches, key = carry
+        cur = jnp.where(t < plen, padded[:, t], tok)  # teacher-force prompt
+        x = params["embed"][cur][:, None, :] + params["pos"][t][None, None, :]
+        new_caches = []
+        for layer, cache in zip(params["layers"], caches):
+            x, c2 = _decode_block(layer, x, cache, t, cfg)
+            new_caches.append(c2)
+        x = rmsnorm(x, params["final_norm"]["g"])
+        logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
+        # temperature is a static Python float: the greedy graph carries no
+        # sampling ops or per-step key splits at all.
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        out = (cur, logits) if collect_logits else cur
+        return (nxt.astype(jnp.int32), new_caches, key), out
+
+    init = (jnp.zeros((b,), jnp.int32), caches, key)
+    _, out = jax.lax.scan(step, init, jnp.arange(total))
+    # The consumed token at t is the prompt for t < plen, then the samples —
+    # so the transposed collection IS the full output sequence. Logits are
+    # only stacked when requested: generation would otherwise materialize a
+    # (total, B, vocab) fp32 array just to discard it.
+    if collect_logits:
+        toks, logits = out
+        return jnp.swapaxes(toks, 0, 1), jnp.swapaxes(logits, 0, 1)
+    return jnp.swapaxes(out, 0, 1), None
+
+
+def decode_logits(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig = TINY_LM
+) -> jax.Array:
+    """Teacher-forced logits through the KV-cache decode path — must match
+    ``forward_lm`` (the parity contract tests/test_decode.py enforces)."""
+    _, logits = _decode_scan(
+        params, tokens, cfg, 0, 0.0, jax.random.PRNGKey(0), collect_logits=True
+    )
+    return logits
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: TransformerConfig = TINY_LM,
+    *,
+    steps: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive generation. prompt (B, P) int32 -> (B, P + steps).
+
+    ``temperature == 0``: greedy argmax; otherwise categorical sampling at
+    the given temperature (``key`` required). One jitted lax.scan over
+    time with per-layer KV caches — O(L) per token, static shapes.
+    """
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature > 0 sampling needs an explicit key")
+    seq, _ = _decode_scan(
+        params, prompt, cfg, steps, temperature,
+        key if key is not None else jax.random.PRNGKey(0),
+    )
+    return seq

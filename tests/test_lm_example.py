@@ -126,3 +126,14 @@ def test_lm_bf16_accum_converges(capsys):
     assert "-> PASSED" in out
     assert "bf16-mixed" in out and "accum=2" in out
     assert lm.main(["--accum-steps", "3", "--batch", "4"]) == 2
+
+
+def test_lm_generate_cli(capsys):
+    """--generate N: trains, then greedy-decodes via the KV-cache path and
+    verifies the pattern continuation in one CLI run."""
+    rc = lm.main(
+        ["--steps", "60", "--seq-len", "64", "--batch", "4", "--generate", "16"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Generation continuation: PASSED" in out
