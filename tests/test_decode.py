@@ -87,3 +87,23 @@ def test_sampling_and_guards(setup):
     )
     with pytest.raises(ValueError, match="dense FFN"):
         generate(init_transformer(jax.random.PRNGKey(2), moe), tokens[:, :8], moe, steps=2)
+
+
+def test_generate_with_tp_sharded_params():
+    """Serving under tensor parallelism: generate() with Megatron-TP-sharded
+    params (8-way) produces exactly the replicated sequence — GSPMD
+    partitions the decode einsums with no decode-specific code."""
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.tensor_parallel import (
+        shard_lm_params_tp,
+    )
+
+    key = jax.random.PRNGKey(5)
+    params = init_transformer(key, CFG)
+    prompt = jax.random.randint(key, (2, 8), 0, CFG.vocab)
+    ref = np.asarray(generate(params, prompt, CFG, steps=12))
+    tp_params = shard_lm_params_tp(params, make_mesh(8, axis_name="tp"))
+    got = np.asarray(
+        jax.jit(lambda p, pr: generate(p, pr, CFG, steps=12))(tp_params, prompt)
+    )
+    np.testing.assert_array_equal(got, ref)
