@@ -25,8 +25,10 @@ if ! timeout 120 python -u -c "import jax; print((jax.numpy.ones((8,8))@jax.nump
 fi
 echo "${TS} OK (on_heal: queue started)" >> "$PROBE_LOG"
 
-say "capture_evidence (full matrix incl. sharded family)"
-timeout 3000 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"
+say "capture_evidence (full matrix; sharded family runs FIRST — see capture_evidence.py)"
+# 5400 s: ~80 (config, batch, compute) cases, each a fresh XLA compile for
+# the never-captured sharded family — 3000 s truncated round-3's attempt.
+timeout 5400 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"
 
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
 
