@@ -674,11 +674,13 @@ def cmd_narrative(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
     for name, key, desc in _STAGES:
         say(f"| {name} | `{key}` | {desc} |")
     say("")
+    # COALESCE like the views: legacy NULL-corpus rows count as local
+    # (SQL NULL != 'reference' is NULL, which would drop them from BOTH).
     n_ref = conn.execute(
-        "SELECT COUNT(*) FROM summary_runs WHERE corpus='reference'"
+        "SELECT COUNT(*) FROM summary_runs WHERE COALESCE(corpus,'')='reference'"
     ).fetchone()[0]
     n_loc = conn.execute(
-        "SELECT COUNT(*) FROM summary_runs WHERE corpus!='reference'"
+        "SELECT COUNT(*) FROM summary_runs WHERE COALESCE(corpus,'')!='reference'"
     ).fetchone()[0]
     say(
         f"Warehouse contents: {n_ref} reference-corpus rows (the reference's "

@@ -163,12 +163,45 @@ def main(argv=None) -> int:
         if err is not None:
             print(err, file=sys.stderr)
             return 2
+    # Accum / generate guards — BEFORE any param init, checkpoint load, or
+    # device placement (pre-work clean-rc=2 policy, like every guard above).
+    if args.accum_steps < 1:
+        print(f"--accum-steps must be >= 1, got {args.accum_steps}", file=sys.stderr)
+        return 2
+    if args.batch % args.accum_steps:
+        print(
+            f"--accum-steps must divide --batch "
+            f"({args.batch} % {args.accum_steps} != 0)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.pp_stages and (args.batch // args.accum_steps) % args.microbatches:
+        # The scan hands batch/accum rows to the pipeline loss, which then
+        # splits by --microbatches — guard the composition here or it
+        # surfaces as a raw trace-time ValueError.
+        print(
+            f"--accum-steps {args.accum_steps} with --pp-stages leaves "
+            f"microbatches of {args.batch // args.accum_steps} rows, not "
+            f"divisible by --microbatches {args.microbatches}",
+            file=sys.stderr,
+        )
+        return 2
+    eff_max_len = max(TINY_LM.max_len, args.seq_len)
+    if args.generate > 0 and not args.experts:
+        plen = min(16, args.seq_len)
+        if plen + args.generate > eff_max_len:
+            print(
+                f"--generate {args.generate} exceeds max_len "
+                f"{eff_max_len} - prompt {plen}",
+                file=sys.stderr,
+            )
+            return 2
     cfg = dataclasses.replace(
         TINY_LM,
         attn_impl=args.attn,
         attn_engine=args.sp_engine,
         sp_shards=args.shards,
-        max_len=max(TINY_LM.max_len, args.seq_len),
+        max_len=eff_max_len,
         n_experts=args.experts,
         remat=args.remat,
     )
@@ -254,39 +287,7 @@ def main(argv=None) -> int:
     )
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
 
-    if args.accum_steps < 1:
-        print(f"--accum-steps must be >= 1, got {args.accum_steps}", file=sys.stderr)
-        return 2
-    if args.batch % args.accum_steps:
-        print(
-            f"--accum-steps must divide --batch "
-            f"({args.batch} % {args.accum_steps} != 0)",
-            file=sys.stderr,
-        )
-        return 2
-    if args.pp_stages and (args.batch // args.accum_steps) % args.microbatches:
-        # The scan hands batch/accum rows to the pipeline loss, which then
-        # splits by --microbatches — guard the composition here or it
-        # surfaces as a raw trace-time ValueError.
-        print(
-            f"--accum-steps {args.accum_steps} with --pp-stages leaves "
-            f"microbatches of {args.batch // args.accum_steps} rows, not "
-            f"divisible by --microbatches {args.microbatches}",
-            file=sys.stderr,
-        )
-        return 2
-    if args.generate > 0 and not cfg.n_experts:
-        # Pre-work guard (clean rc=2 policy): don't train for minutes and
-        # then reject the generation length.
-        plen = min(16, args.seq_len)
-        if plen + args.generate > cfg.max_len:
-            print(
-                f"--generate {args.generate} exceeds max_len "
-                f"{cfg.max_len} - prompt {plen}",
-                file=sys.stderr,
-            )
-            return 2
-    step_kw = dict(
+    step_kw = dict(  # accum/generate guards ran pre-work, with the others
         lr=args.lr,
         accum_steps=args.accum_steps,
         compute_dtype=jnp.bfloat16 if args.compute == "bf16" else None,

@@ -331,22 +331,23 @@ def make_lm_train_step(
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if loss_fn is None:
         loss_fn = lambda p, t: lm_loss(p, t, cfg, mesh)  # noqa: E731
-    if compute_dtype is not None:
-        inner_loss = loss_fn
-
-        def loss_fn(p, t):  # noqa: F811 — deliberate wrap
-            pc = jax.tree.map(
+    @jax.jit
+    def step(params, opt_state, tokens):
+        # Mixed precision: cast the param tree ONCE per optimizer step (a
+        # cast inside the accumulation scan would re-run per microbatch)
+        # and differentiate at the low-precision point — the cast's VJP is
+        # the final grads.astype back to the fp32 masters.
+        if compute_dtype is not None:
+            gp = jax.tree.map(
                 lambda a: a.astype(compute_dtype)
                 if jnp.issubdtype(a.dtype, jnp.floating)
                 else a,
-                p,
+                params,
             )
-            return inner_loss(pc, t)
-
-    @jax.jit
-    def step(params, opt_state, tokens):
+        else:
+            gp = params
         if accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            loss, grads = jax.value_and_grad(loss_fn)(gp, tokens)
         else:
             b = tokens.shape[0]
             if b % accum_steps:
@@ -357,10 +358,12 @@ def make_lm_train_step(
 
             def acc(carry, mb):
                 loss_sum, grad_sum = carry
-                l_mb, g_mb = jax.value_and_grad(loss_fn)(params, mb)
+                l_mb, g_mb = jax.value_and_grad(loss_fn)(gp, mb)
+                # Accumulate at MASTER precision: bf16 + bf16 + ... loses
+                # low bits exactly where accumulation is supposed to help.
                 return (
                     loss_sum + l_mb,
-                    jax.tree.map(jnp.add, grad_sum, g_mb),
+                    jax.tree.map(lambda s, g: s + g.astype(s.dtype), grad_sum, g_mb),
                 ), None
 
             zeros = jax.tree.map(jnp.zeros_like, params)
@@ -369,6 +372,8 @@ def make_lm_train_step(
             )
             loss = loss_sum / accum_steps
             grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
+        if compute_dtype is not None:
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         updates, new_opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state, loss
 

@@ -83,7 +83,11 @@ def comm_compute_breakdown(
         needs_halo = (lp.h_top + lp.h_bot) > 0
         if staged:
             collectives = 1 if needs_halo else 0
-            moved_rows = n_shards * lp.b_in if needs_halo else 0
+            # Rows RECEIVED from remote shards: the all_gather delivers the
+            # other (n-1) blocks; the shard's own block is local. Counting
+            # n*b_in would inflate the V4-vs-V5 ratio by n/(n-1) against
+            # the ppermute side's received-rows accounting.
+            moved_rows = (n_shards - 1) * lp.b_in if needs_halo else 0
         else:
             collectives = math.ceil(lp.h_top / lp.b_in) + math.ceil(lp.h_bot / lp.b_in)
             moved_rows = lp.h_top + lp.h_bot

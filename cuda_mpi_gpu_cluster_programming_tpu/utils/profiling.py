@@ -25,7 +25,7 @@ import jax
 
 from ..models.alexnet import BLOCKS12, ConvSpec, LrnSpec, Params, PoolSpec
 from ..ops import reference as ops
-from .timing import amortized_ms
+from .timing import amortized_stats
 
 
 def _fc_stage(name: str, relu_after: bool):
@@ -169,7 +169,13 @@ def layer_breakdown(
     cur = x
     for name, fn in stage_fns(cfg, tier=tier):
         jfn = jax.jit(fn)
-        ms = amortized_ms(jfn, params, cur, n_small=max(1, warmup), n_large=max(1, warmup) + max(1, repeats))
+        # Work-floor stats (median of >=3 chains): per-layer times are
+        # sub-ms, exactly the regime where a single amortized sample
+        # carried ~40% relay noise (round-3 verdict).
+        ms = amortized_stats(
+            jfn, params, cur,
+            n_small=max(1, warmup), n_large=max(1, warmup) + max(1, repeats),
+        ).per_call_ms
         cur = jax.block_until_ready(jfn(params, cur))
         rows.append((name, ms, tuple(cur.shape)))
     return rows

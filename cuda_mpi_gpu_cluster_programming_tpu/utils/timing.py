@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import statistics
 import time
-from typing import Any, Callable, List
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -124,7 +124,7 @@ class AmortizedStats:
 
 def amortized_stats(
     fn: Callable, *args: Any, n_small: int = 10, n_large: int = 110,
-    max_chain: int = 4096, work_floor_ms: float = 100.0,
+    max_chain: int = 4096, work_floor_ms: Optional[float] = None,
     min_samples: int = 3, max_samples: int = 15,
 ) -> AmortizedStats:
     """Honest per-call wall time: enqueue N calls, fence on the last output,
@@ -154,9 +154,19 @@ def amortized_stats(
     ``min_samples``..``max_samples`` times — stopping once the spread is
     resolved (ci95 < 5% of the median) — so the result carries n and a CI
     instead of a single noisy point.
+
+    ``work_floor_ms=None`` (the default) resolves per platform: 100 ms on
+    accelerators, 0 on the CPU backend. The floor exists for the tunneled
+    TPU's relay RTT, which CPU doesn't have — and XLA's CPU collective
+    thunks ABORT (CollectivePermuteThunk SIGABRT, observed with the
+    sharded configs on a virtual mesh) when a work-floor-grown chain
+    queues tens of unfenced multi-device programs. Explicit values are
+    always honored.
     """
     if n_large <= n_small:
         raise ValueError(f"n_large ({n_large}) must exceed n_small ({n_small})")
+    if work_floor_ms is None:
+        work_floor_ms = 0.0 if jax.default_backend() == "cpu" else 100.0
     if min_samples < 1 or max_samples < min_samples:
         raise ValueError(f"need 1 <= min_samples <= max_samples, got {min_samples}/{max_samples}")
     _block(fn(*args))  # compile

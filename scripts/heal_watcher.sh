@@ -57,5 +57,11 @@ print('PROBE_OK', d.platform, v)" 2>"$ERRF")
     fi
     sleep 240
 done
-echo "$(date -u +%Y-%m-%dT%H:%MZ) watcher deadline reached, tunnel never healed" >> "$PLOG"
+# Honest close-out: a transient flap (probe OK but on_heal rc=3) is not a
+# completed heal — don't contradict any OK lines above.
+if grep -q "OK (watcher: tunnel healed" "$PLOG" 2>/dev/null; then
+    echo "$(date -u +%Y-%m-%dT%H:%MZ) watcher deadline reached without a COMPLETED heal (transient flap(s) above re-wedged before the queue ran)" >> "$PLOG"
+else
+    echo "$(date -u +%Y-%m-%dT%H:%MZ) watcher deadline reached, tunnel never healed" >> "$PLOG"
+fi
 exit 4

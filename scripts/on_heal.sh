@@ -25,6 +25,25 @@ if ! timeout 120 python -u -c "import jax; print((jax.numpy.ones((8,8))@jax.nump
 fi
 echo "${TS} OK (on_heal: queue started)" >> "$PROBE_LOG"
 
+say "vma-checker probe (first-ever real-TPU run of the check_vma=True tagged path)"
+# The tagged path can't execute in CI (interpret mode drops vma tags), so
+# probe it on a tiny sharded forward BEFORE spending the heal window: if
+# the chip-side checker rejects it, disable via the kill-switch and keep
+# capturing — correctness is unaffected (check_vma is a static analyzer).
+if ! timeout 300 python - >>"$LOG" 2>&1 <<'EOF'
+import jax, numpy as np
+from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    deterministic_input, init_params_deterministic)
+fwd = build_forward(REGISTRY["v5_collective"], n_shards=1)  # pallas tier + halo path
+out = np.asarray(fwd(init_params_deterministic(), deterministic_input(batch=1)))
+print("vma probe ok", out.shape)
+EOF
+then
+    say "vma probe FAILED on chip — exporting TPU_FRAMEWORK_CHECK_VMA=0 for this queue (see $LOG)"
+    export TPU_FRAMEWORK_CHECK_VMA=0
+fi
+
 say "capture_evidence (full matrix; sharded family runs FIRST — see capture_evidence.py)"
 # 5400 s: ~80 (config, batch, compute) cases, each a fresh XLA compile for
 # the never-captured sharded family — 3000 s truncated round-3's attempt.

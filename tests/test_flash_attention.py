@@ -225,3 +225,16 @@ def test_vma_struct_policy():
     # In interpret mode the tag is dropped (jax's interpreter cannot
     # propagate vma through discharged kernels).
     assert vma_struct((2, 2), "float32", ("sp",)).vma is None
+
+
+def test_check_vma_env_override(monkeypatch):
+    """TPU_FRAMEWORK_CHECK_VMA is the operational kill-switch for the
+    on-TPU tagged path (probed by on_heal.sh before the capture)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.vma import kernel_check_vma
+
+    monkeypatch.delenv("TPU_FRAMEWORK_CHECK_VMA", raising=False)
+    assert kernel_check_vma() is False  # CPU test backend = interpret mode
+    monkeypatch.setenv("TPU_FRAMEWORK_CHECK_VMA", "1")
+    assert kernel_check_vma() is True
+    monkeypatch.setenv("TPU_FRAMEWORK_CHECK_VMA", "0")
+    assert kernel_check_vma() is False
