@@ -397,6 +397,34 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32):
     ]
 
 
+def _moe_ffn_decode(layer: Params, h: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Capacity-∞ single-token Switch FFN for the decode path.
+
+    The training-time capacity queue (``moe_ffn``'s ``pos < cap``) ranks
+    tokens in flattened batch-major order over the FULL (B, L) set — an
+    incremental decoder cannot know batch 0's future tokens before batch
+    1's early ones, so exact drop parity is impossible one token at a
+    time. Serving therefore routes every token to its argmax expert with
+    NO capacity limit (capacity=∞) — identical math to ``moe_ffn``
+    whenever training would not have dropped the token, which the
+    teacher-forced parity test pins down with an undroppable capacity
+    factor (tests/test_decode.py). Cost note: all E experts are computed
+    for the single token and one selected (static shapes beat an E-way
+    gather at serving's B x 1 sizes; E times a tiny FFN).
+    """
+    b, l, d = h.shape
+    hf = h.reshape(b * l, d)
+    router_logits = hf.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)  # (T, E) fp32
+    idx = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, idx[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(idx, cfg.n_experts, dtype=h.dtype)
+    hidden = jax.nn.gelu(jnp.einsum("td,edf->tef", hf, layer["w_up"]))
+    out_e = jnp.einsum("tef,efd->ted", hidden, layer["w_down"])
+    sel = onehot * gate.astype(h.dtype)[:, None]  # (T, E): gate on the argmax slot
+    return jnp.einsum("te,ted->td", sel, out_e).reshape(b, l, d)
+
+
 def _decode_block(layer: Params, x: jax.Array, cache, pos, cfg: TransformerConfig):
     """One pre-norm decoder block for ONE token (B, 1, D) at ``pos``.
 
@@ -404,8 +432,7 @@ def _decode_block(layer: Params, x: jax.Array, cache, pos, cfg: TransformerConfi
     fp32 softmax statistics like ops.attention) but attends q against the
     cached K/V prefix instead of the full sequence — the positions > pos
     are masked, so the zero-initialized tail of the cache never
-    contributes. Dense FFN only (MoE capacity depends on the full token
-    count, so an incremental MoE decode would not match training routing).
+    contributes. MoE configs route capacity-∞ (see ``_moe_ffn_decode``).
     """
     b = x.shape[0]
     h = rmsnorm(x, layer["attn_norm"]["g"])
@@ -431,7 +458,10 @@ def _decode_block(layer: Params, x: jax.Array, cache, pos, cfg: TransformerConfi
     out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32)).astype(x.dtype)
     x = x + out.reshape(b, 1, cfg.d_model) @ layer["wo"]
     h2 = rmsnorm(x, layer["mlp_norm"]["g"])
-    x = x + jax.nn.gelu(h2 @ layer["w_up"]) @ layer["w_down"]
+    if cfg.n_experts:
+        x = x + _moe_ffn_decode(layer, h2, cfg)
+    else:
+        x = x + jax.nn.gelu(h2 @ layer["w_up"]) @ layer["w_down"]
     return x, {"k": ck, "v": cv}
 
 
@@ -440,11 +470,6 @@ def _decode_scan(params, prompt, cfg, steps, temperature, key, collect_logits=Fa
     total = plen + steps
     if total > cfg.max_len:
         raise ValueError(f"prompt + steps = {total} exceeds max_len {cfg.max_len}")
-    if cfg.n_experts:
-        raise ValueError(
-            "KV-cache decode supports dense FFN configs only (MoE capacity "
-            "routing depends on the full token count)"
-        )
     caches = init_kv_cache(cfg, b, params["embed"].dtype)
     padded = jnp.pad(prompt, ((0, 0), (0, steps)))
 
@@ -469,15 +494,24 @@ def _decode_scan(params, prompt, cfg, steps, temperature, key, collect_logits=Fa
         return (nxt.astype(jnp.int32), new_caches, key), out
 
     init = (jnp.zeros((b,), jnp.int32), caches, key)
-    _, out = jax.lax.scan(step, init, jnp.arange(total))
     # The consumed token at t is the prompt for t < plen, then the samples —
     # so the transposed collection IS the full output sequence. Logits are
     # only stacked when requested: generation would otherwise materialize a
     # (total, B, vocab) fp32 array just to discard it.
     if collect_logits:
-        toks, logits = out
+        # Logits at the LAST position are part of the parity contract with
+        # forward_lm, and only iteration total-1's forward pass computes
+        # them — all total iterations are needed here.
+        _, (toks, logits) = jax.lax.scan(step, init, jnp.arange(total))
         return jnp.swapaxes(toks, 0, 1), jnp.swapaxes(logits, 0, 1)
-    return jnp.swapaxes(out, 0, 1), None
+    # Generation: iteration total-1 would run a full forward pass only to
+    # sample a token nothing consumes (round-4 advisor) — scan total-1
+    # steps and append the final carry (the sample for position total-1;
+    # steps >= 1 guarantees that position is generated, not prompt).
+    (last_tok, _, _), toks = jax.lax.scan(step, init, jnp.arange(total - 1))
+    return jnp.concatenate(
+        [jnp.swapaxes(toks, 0, 1), last_tok[:, None]], axis=1
+    ), None
 
 
 def decode_logits(

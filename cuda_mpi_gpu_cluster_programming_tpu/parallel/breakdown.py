@@ -107,6 +107,93 @@ def comm_compute_breakdown(
     return rows
 
 
+def tp_comm_compute_breakdown(
+    cfg: Blocks12Config,
+    n_shards: int,
+    batch: int = 1,
+    dtype_bytes: int = 4,
+) -> List[LayerCost]:
+    """Per-layer static costs for the ``tp`` (conv filter-decomposition)
+    strategy — the dual of the row plan above, with the "halo" rotated onto
+    the channel axis (parallel/tensor_parallel.py). Exact for the same
+    reason: every width below is a Python int at trace time.
+
+    Comm events per pass (n > 1):
+    - conv2's row carries the ONE boundary ``all_gather`` (conv2 consumes
+      every conv1 channel; each shard receives the other n-1 channel
+      blocks of pool1's output).
+    - lrn2's row carries the channel-halo ``ppermute`` pair (``size//2``
+      neighbor channels from each side).
+    ``h_top``/``h_bot`` hold neighbor CHANNELS here, not rows.
+    """
+    if cfg.conv1.out_channels % n_shards or cfg.conv2.out_channels % n_shards:
+        raise ValueError(
+            f"conv K axes ({cfg.conv1.out_channels}, {cfg.conv2.out_channels}) "
+            f"not divisible by {n_shards} tp shards"
+        )
+    half = cfg.lrn2.size // 2
+    k1l = cfg.conv1.out_channels // n_shards  # local conv1 filters
+    k2l = cfg.conv2.out_channels // n_shards  # local conv2 filters
+    h1 = conv_out_dim(cfg.in_height, cfg.conv1.filter_size, cfg.conv1.padding, cfg.conv1.stride)
+    w1 = conv_out_dim(cfg.in_width, cfg.conv1.filter_size, cfg.conv1.padding, cfg.conv1.stride)
+    hp1 = pool_out_dim(h1, cfg.pool1.window, cfg.pool1.stride)
+    wp1 = pool_out_dim(w1, cfg.pool1.window, cfg.pool1.stride)
+    h2 = conv_out_dim(hp1, cfg.conv2.filter_size, cfg.conv2.padding, cfg.conv2.stride)
+    w2 = conv_out_dim(wp1, cfg.conv2.filter_size, cfg.conv2.padding, cfg.conv2.stride)
+    hp2 = pool_out_dim(h2, cfg.pool2.window, cfg.pool2.stride)
+    wp2 = pool_out_dim(w2, cfg.pool2.window, cfg.pool2.stride)
+    # The lrn normalizes over the halo-extended slice, then crops.
+    lrn_c = k2l + 2 * half if n_shards > 1 else k2l
+    rows = [
+        LayerCost(
+            name="conv1", kind="conv", h_top=0, h_bot=0, collectives=0, halo_bytes=0,
+            flops=batch * 2 * cfg.conv1.filter_size**2 * cfg.in_channels * k1l * h1 * w1,
+            out_shape=(h1, w1, k1l),
+        ),
+        LayerCost(
+            name="pool1", kind="pool", h_top=0, h_bot=0, collectives=0, halo_bytes=0,
+            flops=batch * cfg.pool1.window**2 * hp1 * wp1 * k1l,
+            out_shape=(hp1, wp1, k1l),
+        ),
+        LayerCost(
+            # The boundary gather is attributed to conv2 — it exists because
+            # conv2 contracts over ALL conv1 channels. The tiled all_gather
+            # always appears in the lowered body (even n=1, where it moves 0
+            # remote bytes), matching the jaxpr assertion.
+            name="conv2", kind="conv", h_top=0, h_bot=0, collectives=1,
+            halo_bytes=batch * hp1 * wp1 * (cfg.conv1.out_channels - k1l) * dtype_bytes,
+            flops=batch * 2 * cfg.conv2.filter_size**2 * cfg.conv1.out_channels * k2l * h2 * w2,
+            out_shape=(h2, w2, k2l),
+        ),
+        LayerCost(
+            name="pool2", kind="pool", h_top=0, h_bot=0, collectives=0, halo_bytes=0,
+            flops=batch * cfg.pool2.window**2 * hp2 * wp2 * k2l,
+            out_shape=(hp2, wp2, k2l),
+        ),
+        LayerCost(
+            name="lrn2", kind="pointwise", h_top=half if n_shards > 1 else 0,
+            h_bot=half if n_shards > 1 else 0,
+            collectives=2 if n_shards > 1 else 0,
+            halo_bytes=(
+                batch * hp2 * wp2 * 2 * half * dtype_bytes if n_shards > 1 else 0
+            ),
+            flops=batch * (2 * cfg.lrn2.size + 4) * hp2 * wp2 * lrn_c,
+            out_shape=(hp2, wp2, k2l),
+        ),
+    ]
+    return rows
+
+
+def expected_tp_collectives(cfg: Blocks12Config, n_shards: int) -> dict:
+    """Collective counts one tp forward must contain, by primitive name —
+    asserted against the compiled jaxpr (tests/test_breakdown.py)."""
+    rows = tp_comm_compute_breakdown(cfg, n_shards)
+    return {
+        "all_gather": sum(r.collectives for r in rows if r.kind == "conv"),
+        "ppermute": sum(r.collectives for r in rows if r.kind == "pointwise"),
+    }
+
+
 def expected_collectives(cfg: Blocks12Config, n_shards: int, staged: bool = False) -> int:
     """Total halo collectives one sharded forward pass must contain —
     the number the compiled jaxpr is asserted against."""
@@ -135,10 +222,14 @@ def _jaxprs_in(p) -> list:
     return []
 
 
-def format_table(rows: List[LayerCost], staged: bool = False) -> str:
+def format_table(
+    rows: List[LayerCost], staged: bool = False, transport: str | None = None
+) -> str:
     """Human table for run.py --breakdown (stdout contract: one line per
-    layer prefixed 'Comm ' so the harness can regex it like timing lines)."""
-    kind = "all_gather" if staged else "ppermute"
+    layer prefixed 'Comm ' so the harness can regex it like timing lines).
+    ``transport`` overrides the header label (the tp strategy's mixed
+    all_gather + channel-halo ppermute plan)."""
+    kind = transport or ("all_gather" if staged else "ppermute")
     out = [
         f"Per-layer comm/compute plan ({kind} transport):",
         f"{'layer':8s} {'halo(t/b)':>9s} {'coll':>4s} {'KiB/pass':>9s} "

@@ -257,6 +257,18 @@ def main(argv=None) -> int:
                 dtype_bytes=dtype_bytes, staged=staged,
             )
             print(format_table(rows, staged=staged))
+        elif exec_cfg.strategy == "tp":
+            # Same static-plan guarantee for the filter-decomposition dual:
+            # channel-halo ppermutes + the conv2 boundary all_gather
+            # (parallel/tensor_parallel.py), asserted against the compiled
+            # jaxpr per primitive in tests/test_breakdown.py.
+            from .parallel.breakdown import format_table, tp_comm_compute_breakdown
+
+            dtype_bytes = 2 if args.compute == "bf16" else 4
+            rows = tp_comm_compute_breakdown(
+                blocks_cfg, args.shards, batch=args.batch, dtype_bytes=dtype_bytes,
+            )
+            print(format_table(rows, transport="all_gather + channel-halo ppermute"))
     return 0
 
 

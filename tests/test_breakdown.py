@@ -20,7 +20,9 @@ from cuda_mpi_gpu_cluster_programming_tpu.parallel.breakdown import (
     comm_compute_breakdown,
     count_primitive,
     expected_collectives,
+    expected_tp_collectives,
     format_table,
+    tp_comm_compute_breakdown,
 )
 
 
@@ -45,6 +47,41 @@ def test_plan_matches_jaxpr_all_gather_count_staged(n):
     assert count_primitive(jaxpr, "all_gather") == expected_collectives(
         BLOCKS12, n, staged=True
     )
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_tp_plan_matches_jaxpr_collective_counts(n):
+    """v7_tp (filter decomposition): the compiled forward contains exactly
+    the planned boundary all_gather and channel-halo ppermute counts —
+    the round-4 verdict's missing static-plan guarantee for the tp dual."""
+    fwd = build_forward(REGISTRY["v7_tp"], n_shards=n)
+    params = init_params_deterministic()
+    x = deterministic_input(batch=2)
+    jaxpr = jax.make_jaxpr(fwd)(params, x)
+    want = expected_tp_collectives(BLOCKS12, n)
+    assert count_primitive(jaxpr, "all_gather") == want["all_gather"]
+    assert count_primitive(jaxpr, "ppermute") == want["ppermute"]
+
+
+def test_tp_breakdown_layer_values():
+    """Spot-check the tp static numbers: the conv2 gather receives the other
+    shards' pool1 channel blocks; the lrn halo is size//2 channels a side."""
+    n, batch = 4, 2
+    rows = tp_comm_compute_breakdown(BLOCKS12, n, batch=batch, dtype_bytes=4)
+    by_name = {r.name: r for r in rows}
+    c2 = by_name["conv2"]
+    assert c2.collectives == 1
+    assert c2.halo_bytes == batch * 27 * 27 * (96 - 96 // n) * 4
+    # conv2 contracts over ALL 96 input channels but owns only K/n filters.
+    assert c2.flops == batch * 2 * 5 * 5 * 96 * (256 // n) * 27 * 27
+    lrn = by_name["lrn2"]
+    assert lrn.collectives == 2 and (lrn.h_top, lrn.h_bot) == (2, 2)
+    assert lrn.halo_bytes == batch * 13 * 13 * 4 * 4  # 2*half=4 channels
+    # conv1/pool1/pool2 are comm-free in the tp plan.
+    assert all(by_name[k].halo_bytes == 0 for k in ("conv1", "pool1", "pool2"))
+    # n=1 degenerates: no channel halo, the (0-remote-byte) gather remains.
+    solo = {r.name: r for r in tp_comm_compute_breakdown(BLOCKS12, 1)}
+    assert solo["lrn2"].collectives == 0 and solo["conv2"].halo_bytes == 0
 
 
 def test_breakdown_layer_values():

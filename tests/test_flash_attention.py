@@ -210,7 +210,7 @@ def test_ring_flash_grad_matches_oracle():
                 )
 
 
-def test_vma_struct_policy():
+def test_vma_struct_policy(monkeypatch):
     """vma tagging: plain without axes; dropped in interpret mode (CPU test
     backend), where kernel_check_vma also prescribes the checker off."""
     from cuda_mpi_gpu_cluster_programming_tpu.ops.vma import (
@@ -219,12 +219,28 @@ def test_vma_struct_policy():
         vma_struct,
     )
 
+    # The ambient shell may export the operational kill-switch =1 (the
+    # documented heal-window workflow); this test asserts the DEFAULT
+    # policy, so clear it (round-4 advisor finding).
+    monkeypatch.delenv("TPU_FRAMEWORK_CHECK_VMA", raising=False)
     assert vma_struct((2, 2), "float32").vma is None
     assert interpret_mode()  # the test mesh is the CPU backend
     assert kernel_check_vma() is False
     # In interpret mode the tag is dropped (jax's interpreter cannot
     # propagate vma through discharged kernels).
     assert vma_struct((2, 2), "float32", ("sp",)).vma is None
+
+
+def test_shape_dtype_struct_vma_kwarg_exists():
+    """API-drift guard (round-4 advisor): the on-TPU tagged path's first-ever
+    run happens in a scarce heal window, so a jax upgrade renaming the
+    ``vma=`` kwarg must surface HERE, in CI, not there. Constructs the
+    tagged struct directly — independent of interpret-mode dropping."""
+    import jax
+
+    s = jax.ShapeDtypeStruct((2, 2), "float32", vma=frozenset({"sp"}))
+    assert s.vma == frozenset({"sp"})
+    assert jax.ShapeDtypeStruct((2, 2), "float32").vma is None
 
 
 def test_check_vma_env_override(monkeypatch):
