@@ -814,6 +814,83 @@ def cmd_narrative(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
         say("(reference corpus not ingested — run the capture/ingest first)")
         say("")
 
+    # --- 4b. This framework's own measured scaling curves -----------------
+    say("## 4b. This framework's own S(N)/E(N) study (virtual CPU mesh)")
+    say("")
+    cpu_rows = [
+        r
+        for r in conn.execute(SPEEDUP_SQL, (baseline,))
+        if r[6] != "reference" and (r[7] or "") == "cpu"
+    ]
+    if cpu_rows:
+        say(
+            "The same shards sweep the reference ran with `mpirun -np N "
+            "--oversubscribe`, measured with THIS framework's own configs on "
+            "the 8-virtual-device CPU mesh — the first self-measured scaling "
+            "rows for the sharded/distributed family. **Honest caveat, same "
+            "as the reference's oversubscribe runs** (its "
+            "common_test_utils.sh warned the ranks share cores): this host "
+            "has ONE physical core, so the mesh time-slices and wall time "
+            "tracks *total work plus partition/collective overhead*, not "
+            "parallel speedup. Read the curves as a work-conservation and "
+            "overhead study: a work-conserving sharded config should hold "
+            "S(N) ≈ 1 (flat time as shards grow), replicate-everything "
+            "should fall as S(N) ≈ 1/N (N× work), and any extra droop is "
+            "the cost of halos/gathers/regrouping. ICI-speedup claims stay "
+            "with the on-chip rows."
+        )
+        say("")
+        say("| variant | np | best ms | S(N) vs V1 | E(N) |")
+        say("|---|---:|---:|---:|---:|")
+        for v, np_, b, ms, s, e, _corpus, _plat in sorted(
+            cpu_rows, key=lambda r: (r[0], r[1])
+        ):
+            say(f"| {v} (b={b}) | {np_} | {ms:.1f} | {s:.2f} | {e:.2f} |")
+        say("")
+        # Per-(variant, batch) np->ms cells — same-batch rows only (a
+        # variant measured at several batches but one np would otherwise
+        # fake a huge "scaling" ratio out of the batch difference), and
+        # only where the np axis actually spans a range.
+        by_cell: dict = {}
+        for v, np_, b, ms, _s, _e, _c, _p in cpu_rows:
+            by_cell.setdefault((v, b), {})[np_] = ms
+        v21_cells = sorted(
+            (pts for (name, _b), pts in by_cell.items()
+             if name == "V2.1 BroadcastAll" and len(pts) >= 2),
+            key=len, reverse=True,
+        )
+        flat = {
+            (f"{name} (b={b})", min(pts), max(pts)): pts[max(pts)] / pts[min(pts)]
+            for (name, b), pts in by_cell.items()
+            if len(pts) >= 2 and name != "V2.1 BroadcastAll"
+        }
+        if v21_cells:
+            pts = v21_cells[0]
+            lo, hi = min(pts), max(pts)
+            say(
+                f"Measured: V2.1 BroadcastAll grows "
+                f"{pts[lo]:.0f} → {pts[hi]:.0f} ms from np={lo} "
+                f"to np={hi} (every shard recomputes everything — the "
+                "reference's negative-scaling lesson, reproduced with this "
+                "framework's own data)."
+            )
+        if flat:
+            (bname, blo, bhi), bratio = min(flat.items(), key=lambda kv: kv[1])
+            (wname, wlo, whi), wratio = max(flat.items(), key=lambda kv: kv[1])
+            say(
+                f"The work-dividing configs hold time ~flat on the shared "
+                f"core: {bratio:.2f}× T(np={bhi})/T(np={blo}) ({bname}) to "
+                f"{wratio:.2f}× T(np={whi})/T(np={wlo}) ({wname}) — the "
+                "spread IS the measured partition/collective overhead."
+            )
+        say("")
+    else:
+        say(
+            "*(no CPU-mesh scaling rows ingested yet — run the shards sweep "
+            "via the harness with --fake-devices and re-ingest)*"
+        )
+        say("")
+
     # --- 5. Where the bytes go --------------------------------------------
     say("## 5. Where the bytes go (static comm/compute plan, 4 shards)")
     say("")

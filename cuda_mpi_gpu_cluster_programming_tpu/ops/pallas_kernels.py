@@ -131,6 +131,19 @@ def _row_block() -> int:
     return int(env_variant("TPU_FRAMEWORK_ROWBLOCK", str(_ROW_BLOCK), ("8", "16", "32")))
 
 
+# Output-channel (K) grid blocking for the taps variant — the third lever,
+# named in the round-4 verdict as the follow-up if pairs/rowblock miss the
+# bar. 0 = off (whole K per program, the historical layout). A K block
+# splits the filter bank across grid programs: each program's weight slice
+# and accumulator shrink K/nk-fold (conv2: 256 -> 128 halves the VMEM-
+# resident weights and the fp32 acc), buying Mosaic pipelining headroom at
+# the cost of re-reading the input window once per K block. Output blocks
+# are disjoint, accumulation order per output element is unchanged —
+# bitwise identical to unblocked, like the rowblock lever.
+def _k_block() -> int:
+    return int(env_variant("TPU_FRAMEWORK_KBLOCK", "0", ("0", "64", "128")))
+
+
 class KernelVariants(NamedTuple):
     """Resolved lowering-variant set — hashable, so it can ride jit static
     args. ``resolve()`` reads the environment ONCE; build-time callers
@@ -143,10 +156,14 @@ class KernelVariants(NamedTuple):
     conv: str = "taps"
     pool: str = "sep2"
     row_block: int = _ROW_BLOCK
+    k_block: int = 0
 
     @classmethod
     def resolve(cls) -> "KernelVariants":
-        return cls(conv=_conv_variant(), pool=_pool_variant(), row_block=_row_block())
+        return cls(
+            conv=_conv_variant(), pool=_pool_variant(), row_block=_row_block(),
+            k_block=_k_block(),
+        )
 
 
 def _mxu_precision(dtype):
@@ -309,6 +326,7 @@ def conv2d_pallas(
     vma=None,
     variant: str | None = None,
     row_block: int | None = None,
+    k_block: int | None = None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU) — thin wrapper resolving the
     lowering variant (explicit arg wins; env var otherwise) before entering
@@ -319,6 +337,7 @@ def conv2d_pallas(
         relu=relu,
         variant=variant if variant is not None else _conv_variant(),
         row_block=row_block if row_block is not None else _row_block(),
+        k_block=k_block if k_block is not None else _k_block(),
         vma=tuple(vma) if vma is not None else None,
     )
 
@@ -326,7 +345,8 @@ def conv2d_pallas(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "stride", "padding", "padding_w", "relu", "variant", "row_block", "vma"
+        "stride", "padding", "padding_w", "relu", "variant", "row_block",
+        "k_block", "vma",
     ),
 )
 def _conv2d_pallas(
@@ -340,6 +360,7 @@ def _conv2d_pallas(
     relu: bool = False,
     variant: str = "taps",
     row_block: int = _ROW_BLOCK,
+    k_block: int = 0,
     vma=None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU). x: (N,H,W,C), w: (F,F,C,K).
@@ -439,6 +460,36 @@ def _conv2d_pallas(
     else:  # "taps" (and "pairs" at fq == 1, where there is nothing to pair)
         operands = (xs, ws2d, b)
         kernel = functools.partial(_conv_kernel, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
+        kk = w.shape[-1]
+        if k_block and kk % k_block == 0 and kk > k_block:
+            # Third grid dim over K blocks (the round-4 verdict's named
+            # next lever): each program owns k_block output channels, so
+            # the VMEM-resident weight slice and fp32 accumulator shrink
+            # kk/k_block-fold at the cost of re-reading the input window
+            # per K block (the x spec ignores the k index, so Mosaic can
+            # keep the window resident across the inner dim). Outputs are
+            # disjoint and per-element accumulation order is untouched —
+            # bitwise identical to unblocked, like the rowblock lever.
+            nk = kk // k_block
+            in_specs = [
+                _vmem_spec((1, hs, ws, cs), lambda i, j, k: (i, 0, 0, 0)),
+                _vmem_spec((fq, fq, cs, k_block), lambda i, j, k: (0, 0, 0, k)),
+                _vmem_spec((k_block,), lambda i, j, k: (k,)),
+            ]
+            out = pl.pallas_call(
+                kernel,
+                grid=(n, nbh, nk),
+                in_specs=in_specs,
+                out_specs=_vmem_spec(
+                    (1, bh, wo_p, k_block), lambda i, j, k: (i, j, 0, k)
+                ),
+                out_shape=vma_struct((n, ho_p, wo_p, kk), x.dtype, vma),
+                compiler_params=_tc_params("parallel", "parallel", "parallel"),
+                interpret=_interpret(),
+            )(*operands)
+            if ho_p != ho or wo_p != wo:
+                out = out[:, :ho, :wo, :]
+            return out
         in_specs = [
             _vmem_spec((1, hs, ws, cs), lambda i, j: (i, 0, 0, 0)),
             _vmem_spec(),
@@ -461,12 +512,13 @@ def _conv2d_pallas(
 def conv2d_pallas_hvalid(
     x, w, b, *, stride: int, padding_w: int, vma=None,
     variant: str | None = None, row_block: int | None = None,
+    k_block: int | None = None,
 ):
     """Sharded-tier entry: VALID on H (halo-provided), padded on W, fused ReLU
     is NOT applied here (the sharded pipeline masks then relus)."""
     return conv2d_pallas(
         x, w, b, stride=stride, padding=0, padding_w=padding_w, vma=vma,
-        variant=variant, row_block=row_block,
+        variant=variant, row_block=row_block, k_block=k_block,
     )
 
 

@@ -67,7 +67,7 @@ def forward_blocks12_pallas(
         w2 = _pad_axis(w2, 2, kp)  # conv2 contraction axis: zero rows
     conv = lambda x, w, b, s: pk.conv2d_pallas(  # noqa: E731
         x, w, b, stride=s.stride, padding=s.padding, relu=True,
-        variant=v.conv, row_block=v.row_block,
+        variant=v.conv, row_block=v.row_block, k_block=v.k_block,
     )
     pool = lambda x, s: pk.maxpool_pallas(  # noqa: E731
         x, window=s.window, stride=s.stride, variant=v.pool
@@ -107,6 +107,7 @@ def forward_alexnet_pallas(
                 relu=True,
                 variant=v.conv,
                 row_block=v.row_block,
+                k_block=v.k_block,
             )
         elif isinstance(spec, PoolSpec):
             x = pk.maxpool_pallas(

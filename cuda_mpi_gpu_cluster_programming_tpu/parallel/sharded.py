@@ -125,7 +125,8 @@ def build_sharded_forward(
         # eagerly at build time (same footgun fix as configs.build_forward).
         kv = KernelVariants.resolve()
         conv_fn = functools.partial(
-            conv2d_pallas_hvalid, vma=(AXIS,), variant=kv.conv, row_block=kv.row_block
+            conv2d_pallas_hvalid, vma=(AXIS,), variant=kv.conv,
+            row_block=kv.row_block, k_block=kv.k_block
         )
         pool_fn = functools.partial(maxpool_pallas, vma=(AXIS,), variant=kv.pool)
     else:

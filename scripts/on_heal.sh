@@ -78,15 +78,19 @@ run_ab() {  # run_ab <outfile> <args...>: JSON rows -> outfile, all output -> LO
 run_ab perf/attention_ab_${FTS}.json --dtype bf16 --lengths 512,2048,8192
 run_ab perf/attention_ab_causal_${FTS}.json --dtype bf16 --lengths 512,2048 --causal
 
-say "conv variant A/B on the real chip: taps vs pairs x rowblock 8/16/32 (round-4 MXU-fill levers)"
-for conv in taps pairs; do
+say "conv variant A/B on the real chip: taps/pairs x rowblock 8/16/32 x kblock 0/128 (rounds-4/5 MXU-fill levers)"
+# kblock (round-5, third lever) applies to the taps path only; conv2's
+# K=256 is the target (weight slice + accumulator halve per program).
+for combo in "taps 0" "taps 128" "pairs 0"; do
+    set -- $combo; conv=$1; kb=$2
     for rb in 8 16 32; do
         for comp in bf16 fp32; do
-            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb timeout 600 \
+            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb \
+            TPU_FRAMEWORK_KBLOCK=$kb timeout 600 \
                 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
                 --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
                 | grep "completed in" \
-                | sed "s/^/conv=$conv rb=$rb $comp /" | tee -a "$LOG"
+                | sed "s/^/conv=$conv rb=$rb kb=$kb $comp /" | tee -a "$LOG"
         done
     done
 done

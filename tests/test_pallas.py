@@ -274,6 +274,36 @@ def test_conv_row_block_variant_bitwise(monkeypatch):
         np.testing.assert_array_equal(np.asarray(conv2d_pallas(x, w, b, stride=4)), r8)
 
 
+def test_conv_k_block_variant_bitwise(monkeypatch):
+    """TPU_FRAMEWORK_KBLOCK splits the filter bank across grid programs
+    (the round-4 verdict's named third lever): outputs are disjoint and the
+    per-element accumulation order is unchanged -> bitwise identical to the
+    unblocked default, including shapes where K % k_block != 0 (lever
+    silently off) and K == k_block (single block)."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 31, 31, 8))
+    w = jax.random.normal(jax.random.PRNGKey(8), (5, 5, 8, 128)) * 0.1
+    b = jax.random.normal(jax.random.PRNGKey(9), (128,)) * 0.1
+    monkeypatch.delenv("TPU_FRAMEWORK_KBLOCK", raising=False)
+    ref = np.asarray(conv2d_pallas(x, w, b, stride=1, padding=2, relu=True))
+    for kb in ("64", "128"):
+        monkeypatch.setenv("TPU_FRAMEWORK_KBLOCK", kb)
+        got = np.asarray(conv2d_pallas(x, w, b, stride=1, padding=2, relu=True))
+        np.testing.assert_array_equal(got, ref)
+    # K=96 (conv1-like) not divisible by 64: the lever degrades to off.
+    w96 = jax.random.normal(jax.random.PRNGKey(10), (5, 5, 8, 96)) * 0.1
+    b96 = jnp.zeros((96,))
+    monkeypatch.delenv("TPU_FRAMEWORK_KBLOCK", raising=False)
+    ref96 = np.asarray(conv2d_pallas(x, w96, b96, stride=1))
+    monkeypatch.setenv("TPU_FRAMEWORK_KBLOCK", "64")
+    np.testing.assert_array_equal(
+        np.asarray(conv2d_pallas(x, w96, b96, stride=1)), ref96
+    )
+
+
 def test_conv_variant_rejects_unknown(monkeypatch):
     import pytest
 
