@@ -1,0 +1,70 @@
+"""Unit tests for the conv A/B log summarizer (scripts/conv_ab_report.py)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+spec = importlib.util.spec_from_file_location(
+    "conv_ab_report", Path(__file__).parent.parent / "scripts" / "conv_ab_report.py"
+)
+mod = importlib.util.module_from_spec(spec)
+sys.modules["conv_ab_report"] = mod
+spec.loader.exec_module(mod)
+
+SAMPLE = """\
+=== conv variant A/B on the real chip
+conv=taps rb=8 kb=0 bf16 AlexNet TPU Forward Pass completed in 5.800 ms (amortized over 100 fenced passes; 22068.9 img/s)
+conv=taps rb=8 kb=0 fp32 AlexNet TPU Forward Pass completed in 15.100 ms (amortized over 100 fenced passes; 8476.8 img/s)
+conv=pairs rb=16 kb=0 bf16 AlexNet TPU Forward Pass completed in 2.100 ms (amortized over 100 fenced passes; 60952.4 img/s)
+unrelated line
+"""
+
+
+def test_parse_extracts_combo_rows():
+    rows = mod.parse(SAMPLE)
+    assert len(rows) == 3
+    assert rows[0] == {
+        "conv": "taps", "rowblock": 8, "kblock": 0, "compute": "bf16",
+        "ms": 5.8, "img_per_sec": 22068.9,
+    }
+    assert rows[2]["conv"] == "pairs" and rows[2]["rowblock"] == 16
+
+
+def test_report_ranks_and_judges_bar():
+    rows = mod.parse(SAMPLE)
+    text = mod.report(rows, {"bf16": 102461.8, "fp32": 21668.3})
+    # Ranked: pairs (60952) above taps (22068) within bf16.
+    assert text.index("| pairs | 16 |") < text.index("| taps | 8 | 0 | bf16")
+    # 60952/102462 = 0.59x -> bar met.
+    assert "BAR MET" in text
+    assert "0.59x" in text
+
+
+def test_report_bar_not_met():
+    rows = mod.parse(SAMPLE.replace("60952.4", "30000.0"))
+    text = mod.report(rows, {"bf16": 102461.8})
+    assert "bar NOT met" in text
+
+
+def test_report_without_reference_is_na():
+    rows = mod.parse(SAMPLE)
+    text = mod.report(rows, {})
+    assert "n/a" in text and "BAR" not in text
+
+
+def test_v1_reference_rejects_mismatched_baseline(tmp_path, monkeypatch):
+    """A bench_latest captured under a different config or batch must not
+    become the bar's denominator (review finding: BENCH_CONFIG/BENCH_BATCH
+    are environment-driven, so the committed headline isn't guaranteed to
+    be v1_jit b=128)."""
+    import json
+    perf = tmp_path / "perf"
+    perf.mkdir()
+    monkeypatch.setattr(mod, "ROOT", tmp_path)
+    good = {"config": "v1_jit", "batch": 128, "compute": "fp32",
+            "value": 21668.3, "bf16": {"value": 102461.8}}
+    perf.joinpath("bench_latest.json").write_text(json.dumps(good))
+    assert mod.v1_reference() == {"fp32": 21668.3, "bf16": 102461.8}
+    for bad in ({**good, "config": "v3_pallas"}, {**good, "batch": 256}):
+        perf.joinpath("bench_latest.json").write_text(json.dumps(bad))
+        assert mod.v1_reference() == {}
