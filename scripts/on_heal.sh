@@ -44,23 +44,91 @@ then
     export TPU_FRAMEWORK_CHECK_VMA=0
 fi
 
-say "capture_evidence (full matrix; sharded family runs FIRST — see capture_evidence.py)"
-# 5400 s: ~80 (config, batch, compute) cases, each a fresh XLA compile for
-# the never-captured sharded family — 3000 s truncated round-3's attempt.
-timeout 5400 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"
+# SKIP_CAPTURE: once a full capture+spread has landed this round, the NEXT
+# heal window must go to the still-missing items (conv A/B first — the
+# round-3/4/5 perf verdict item), not to re-measuring 80 captured cases.
+# Detection is by on-disk marker, NOT ambient env (review finding: the
+# watcher process died once already this round; a restart that forgets an
+# env var must not silently revert to the 90-minute capture path). The
+# marker is written below after a completed capture; explicit SKIP_CAPTURE
+# in the environment still overrides either way.
+ROUND_TAG=$(basename "$PROBE_LOG" .log); ROUND_TAG=${ROUND_TAG#probe_attempts_}
+MARKER=logs/.capture_landed_${ROUND_TAG}
+if [ -z "${SKIP_CAPTURE:-}" ]; then
+    [ -f "$MARKER" ] && SKIP_CAPTURE=1 || SKIP_CAPTURE=0
+fi
+if [ "$SKIP_CAPTURE" != 1 ]; then
+    say "capture_evidence (full matrix; sharded family runs FIRST — see capture_evidence.py)"
+    # 5400 s: ~80 (config, batch, compute) cases, each a fresh XLA compile for
+    # the never-captured sharded family — 3000 s truncated round-3's attempt.
+    timeout 5400 python scripts/capture_evidence.py 2>&1 | tail -25 | tee -a "$LOG"
 
-say "work-floor spread validation: SECOND same-day session of the fast bf16 rows"
-# Round-4 verdict item 6: the amortized work-floor protocol claims <10%
-# session-to-session spread on sub-3 ms bf16 rows (was ~40% pre-protocol).
-# Needs two sessions in one heal window; this second, short sweep re-measures
-# just the fast cells, then the spread is computed across the two newest TPU
-# sessions' common cells.
-timeout 1800 python -m cuda_mpi_gpu_cluster_programming_tpu.harness \
-    --configs v1_jit,v3_pallas --shards 1 --batches 1,32 \
-    --computes fp32,bf16 --timeout 600 --repeats 50 2>&1 | tail -12 | tee -a "$LOG"
-timeout 120 python scripts/session_spread.py 2>&1 | tee -a "$LOG"
+    say "work-floor spread validation: SECOND same-day session of the fast bf16 rows"
+    # Round-4 verdict item 6: the amortized work-floor protocol claims <10%
+    # session-to-session spread on sub-3 ms bf16 rows (was ~40% pre-protocol).
+    # Needs two sessions in one heal window; this second, short sweep re-measures
+    # just the fast cells, then the spread is computed across the two newest TPU
+    # sessions' common cells.
+    timeout 1800 python -m cuda_mpi_gpu_cluster_programming_tpu.harness \
+        --configs v1_jit,v3_pallas --shards 1 --batches 1,32 \
+        --computes fp32,bf16 --timeout 600 --repeats 50 2>&1 | tail -12 | tee -a "$LOG"
+    timeout 120 python scripts/session_spread.py \
+        --out perf/session_spread_latest.json 2>&1 | tee -a "$LOG"
+    touch "$MARKER"
+else
+    say "capture already landed this round ($MARKER) — refreshing the v1 baseline only"
+    # conv_ab_report judges the adoption bar against perf/bench_latest.json
+    # and requires a same-session v1_jit b=128 baseline (review finding:
+    # without this, a days-later window would judge against a stale chip
+    # state). bench.py prints the JSON line; persist it the way
+    # capture_evidence does, but only if it measured something (a flapping
+    # tunnel mid-run must not erase the committed headline with value 0).
+    BENCH_LINE=$(timeout 1200 python bench.py 2>>"$LOG" | tail -1)
+    echo "$BENCH_LINE" | tee -a "$LOG"
+    if echo "$BENCH_LINE" | python -c "import json,sys; d=json.loads(sys.stdin.read()); sys.exit(0 if d.get('value',0)>0 else 1)" 2>/dev/null; then
+        echo "$BENCH_LINE" > perf/bench_latest.json
+    else
+        say "baseline bench failed or value=0 — keeping committed bench_latest; conv_ab_report may refuse the bar"
+    fi
+fi
 
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
+
+say "conv variant A/B on the real chip: taps/pairs x rowblock 8/16/32 x kblock 0/128 (rounds-4/5 MXU-fill levers)"
+# Runs BEFORE the attention A/B since the 01:37Z re-wedge: this is the
+# adoption-gating measurement (v3_pallas bf16 >= 0.5x v1_jit at b=128,
+# carried since round 3) and the next window may be short. bf16 first for
+# the same reason — the bar is a bf16 bar. kblock (round-5, third lever)
+# applies to the taps path only; conv2's K=256 is the target (weight slice
+# + accumulator halve per program).
+for comp in bf16 fp32; do
+    for combo in "taps 0" "taps 128" "pairs 0"; do
+        set -- $combo; conv=$1; kb=$2
+        for rb in 8 16 32; do
+            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb \
+            TPU_FRAMEWORK_KBLOCK=$kb timeout 600 \
+                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
+                | grep "completed in" \
+                | sed "s/^/conv=$conv rb=$rb kb=$kb $comp /" | tee -a "$LOG"
+        done
+    done
+done
+# Summarize + judge the bar from THIS log (no-op rows -> error note only).
+timeout 120 python scripts/conv_ab_report.py "$LOG" 2>&1 | tee -a "$LOG"
+
+say "b=1 fresh-process repeatability diagnostic (3 back-to-back runs of the worst spread cell)"
+# The 2026-07-31 two-session spread check failed ONLY on b=1 cells (34-86%,
+# sessions 25 min apart, each case already a fresh process). Three
+# consecutive fresh-process runs of the worst cell (V1 bf16 b=1) separate
+# back-to-back process variance from slower drift: tight here + loose
+# across sessions = device/relay state drift, loose here too = per-process
+# lowering/dispatch nondeterminism.
+for i in 1 2 3; do
+    timeout 300 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+        --config v1_jit --batch 1 --compute bf16 --repeats 50 2>&1 \
+        | grep "completed in" | sed "s/^/b1diag run$i /" | tee -a "$LOG"
+done
 
 say "attention A/B (non-causal + causal)"
 run_ab() {  # run_ab <outfile> <args...>: JSON rows -> outfile, all output -> LOG
@@ -75,25 +143,11 @@ run_ab() {  # run_ab <outfile> <args...>: JSON rows -> outfile, all output -> LO
     fi
     rm -f "$tmp"
 }
-run_ab perf/attention_ab_${FTS}.json --dtype bf16 --lengths 512,2048,8192
+# 512,2048 before the 8192 call: the 01:37Z wedge hit mid-A/B and a 600 s
+# timeout on the long-length call must not starve the short ones.
+run_ab perf/attention_ab_${FTS}.json --dtype bf16 --lengths 512,2048
 run_ab perf/attention_ab_causal_${FTS}.json --dtype bf16 --lengths 512,2048 --causal
-
-say "conv variant A/B on the real chip: taps/pairs x rowblock 8/16/32 x kblock 0/128 (rounds-4/5 MXU-fill levers)"
-# kblock (round-5, third lever) applies to the taps path only; conv2's
-# K=256 is the target (weight slice + accumulator halve per program).
-for combo in "taps 0" "taps 128" "pairs 0"; do
-    set -- $combo; conv=$1; kb=$2
-    for rb in 8 16 32; do
-        for comp in bf16 fp32; do
-            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb \
-            TPU_FRAMEWORK_KBLOCK=$kb timeout 600 \
-                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
-                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
-                | grep "completed in" \
-                | sed "s/^/conv=$conv rb=$rb kb=$kb $comp /" | tee -a "$LOG"
-        done
-    done
-done
+run_ab perf/attention_ab_8k_${FTS}.json --dtype bf16 --lengths 8192
 
 say "sharded comm/compute breakdown on the real chip (v2.2 shards=1, static plan + measured layers)"
 timeout 900 python -m cuda_mpi_gpu_cluster_programming_tpu.run \

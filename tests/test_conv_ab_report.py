@@ -11,11 +11,12 @@ mod = importlib.util.module_from_spec(spec)
 sys.modules["conv_ab_report"] = mod
 spec.loader.exec_module(mod)
 
-SAMPLE = """\
+_PASS = "AlexNet TPU Forward Pass completed in"
+SAMPLE = f"""\
 === conv variant A/B on the real chip
-conv=taps rb=8 kb=0 bf16 AlexNet TPU Forward Pass completed in 5.800 ms (amortized over 100 fenced passes; 22068.9 img/s)
-conv=taps rb=8 kb=0 fp32 AlexNet TPU Forward Pass completed in 15.100 ms (amortized over 100 fenced passes; 8476.8 img/s)
-conv=pairs rb=16 kb=0 bf16 AlexNet TPU Forward Pass completed in 2.100 ms (amortized over 100 fenced passes; 60952.4 img/s)
+conv=taps rb=8 kb=0 bf16 {_PASS} 5.800 ms (amortized over 100 fenced passes; 22068.9 img/s)
+conv=taps rb=8 kb=0 fp32 {_PASS} 15.100 ms (amortized over 100 fenced passes; 8476.8 img/s)
+conv=pairs rb=16 kb=0 bf16 {_PASS} 2.100 ms (amortized over 100 fenced passes; 60952.4 img/s)
 unrelated line
 """
 

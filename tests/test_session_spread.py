@@ -114,5 +114,26 @@ def test_fewer_than_two_real_sessions_is_a_noop(tmp_path, capsys):
     assert "nothing to compare" in out
 
 
+def test_out_persists_json_and_defaults_off(tmp_path, capsys):
+    """--out writes the machine-readable comparison the narrative quotes;
+    the default is OFF so test/ad-hoc invocations cannot clobber the
+    canonical perf/session_spread_latest.json (review finding)."""
+    import json
+    write_session(tmp_path, "bench_1_tpu", [("V1", "v1_jit", "1", "1", "OK", 0.2)])
+    write_session(tmp_path, "bench_2_tpu", [("V1", "v1_jit", "1", "1", "OK", 0.5)])
+    out = tmp_path / "spread.json"
+    rc, _ = run_main(["--logs", str(tmp_path), "--out", str(out)], capsys)
+    assert rc == 1  # 0.2 vs 0.5 ms: sub-3ms spread way over the bar
+    d = json.loads(out.read_text())
+    assert d["sessions"] == ["bench_1_tpu", "bench_2_tpu"]
+    assert d["failed_cells"] == ["V1 np=1 b=1"]
+    assert d["cells"][0]["batch"] == 1 and d["cells"][0]["sub3ms"] is True
+    assert 0.85 < d["worst_sub3ms_spread"] < 0.86
+    # default: no file appears anywhere
+    before = set(Path.cwd().rglob("session_spread_latest.json"))
+    rc, _ = run_main(["--logs", str(tmp_path)], capsys)
+    assert set(Path.cwd().rglob("session_spread_latest.json")) == before
+
+
 # keep the module import honest if pytest reruns within one process
 sys.modules.setdefault("session_spread", session_spread)
