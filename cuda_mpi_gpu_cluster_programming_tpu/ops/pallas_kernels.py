@@ -110,14 +110,25 @@ def env_variant(env_name: str, default: str, allowed: tuple) -> str:
 #   "fused" — host-side im2col + ONE big matmul per row block. Measured
 #             ~2x SLOWER on v5e (docs/PALLAS_PERF.md round-3 results);
 #             kept as the recorded negative result.
+#   "vcol"  — in-kernel (VMEM) im2col over the qw taps: taps' 1x HBM
+#             traffic with one fq*cs-contraction matmul per qh row
+#             (round-5 lever; see _conv_vcol_kernel). ADOPTED as the
+#             default from the 2026-07-31 on-chip A/B: with rowblock 64
+#             it is the grid winner at b=128 — bf16 2.997 ms (42.7k
+#             img/s, 0.42x v1_jit, from taps' 0.38x) and fp32 11.003 ms
+#             (11.6k img/s, 0.53x v1_jit — the first tier/compute cell
+#             to clear the 0.5x adoption bar).
 def _conv_variant() -> str:
-    return env_variant("TPU_FRAMEWORK_CONV", "taps", ("taps", "pairs", "fused"))
+    return env_variant("TPU_FRAMEWORK_CONV", "vcol", ("taps", "pairs", "fused", "vcol"))
 
 
 # Default output rows per conv program (TPU_FRAMEWORK_ROWBLOCK overrides).
-# BH * Wo_pad is the matmul M dim: 8*64=512 for conv1, 8*32=256 for conv2 —
-# comfortably MXU-sized without bloating the per-program VMEM footprint.
-_ROW_BLOCK = 8
+# BH * Wo_pad is the matmul M dim. 64 (i.e. the whole 55/27-row image for
+# the AlexNet convs, grid over batch only) won the 2026-07-31 on-chip
+# rowblock sweep at every measured cell — 8/16/32/64 bf16 full pass:
+# 3.588/3.642/3.219/2.997 ms with vcol — the per-program VMEM footprint
+# (conv1 rb=64: ~360 KB window + ~1.4 MB acc) stays well under budget.
+_ROW_BLOCK = 64
 # W padded up to this multiple so the (BH, Wo, C) -> (BH*Wo, C) collapse is
 # sublane-aligned for fp32 (8) and bf16 (16) alike.
 _W_ALIGN = 16
@@ -128,7 +139,7 @@ _W_ALIGN = 16
 # work at more VMEM per program — the round-3 verdict's lever (b), made
 # measurable now that the sep2 pool freed VMEM headroom.
 def _row_block() -> int:
-    return int(env_variant("TPU_FRAMEWORK_ROWBLOCK", str(_ROW_BLOCK), ("8", "16", "32")))
+    return int(env_variant("TPU_FRAMEWORK_ROWBLOCK", str(_ROW_BLOCK), ("8", "16", "32", "64")))
 
 
 # Output-channel (K) grid blocking for the taps variant — the third lever,
@@ -153,7 +164,7 @@ class KernelVariants(NamedTuple):
     outer jit's trace: every ``build_forward`` call now re-reads the env
     and returns a fresh function carrying its variants explicitly."""
 
-    conv: str = "taps"
+    conv: str = "vcol"
     pool: str = "sep2"
     row_block: int = _ROW_BLOCK
     k_block: int = 0
@@ -285,6 +296,48 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, rel
                 preferred_element_type=jnp.float32,
                 precision=prec,
             )
+    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
+
+
+def _conv_vcol_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, relu: bool):
+    """VMEM-level im2col over the qw taps (round-5 lever, named from the
+    per-layer A/B in scripts/v3_layer_ab.py): same operands and HBM
+    traffic as "taps" (1x input), but the fq qw-windows are concatenated
+    on the lane axis INSIDE the kernel, so each qh row is ONE matmul with
+    an fq*cs contraction (conv1: 144 vs 48 of the MXU's 128 rows; conv2:
+    480 vs 96) instead of fq skinny ones. This is "pairs"/"fused"'s fill
+    win without their host-side HBM blowup — the concat is a VMEM lane
+    relayout, whose cost is what the A/B measures. Accumulation: one
+    reduction per qh over fq*cs (deterministic; differs from taps in the
+    last ulps like the other variants — allclose across variants, bitwise
+    within)."""
+    cs = x_ref.shape[-1]
+    k = w_ref.shape[-1]
+    row0 = pl.program_id(1) * bh
+    prec = _mxu_precision(x_ref.dtype)
+    acc = jnp.zeros((bh * wo_p, k), jnp.float32)
+    for qh in range(fq):
+        # The qw windows are sublane-shifted views of the same rows;
+        # Mosaic's concat requires matching offsets on non-concat dims
+        # ("result/input offset mismatch", measured on v5e), so each
+        # window is reshaped to 2-D FIRST — merging the (bh, wo_p) tiles
+        # forces an offset-0 materialization — and the concat runs on the
+        # lane axis of the already-flat operands.
+        wide = jnp.concatenate(
+            [
+                x_ref[0, pl.ds(row0 + qh, bh), qw : qw + wo_p, :].reshape(
+                    bh * wo_p, cs
+                )
+                for qw in range(fq)
+            ],
+            axis=-1,
+        )
+        acc = acc + jnp.dot(
+            wide,
+            w_ref[qh].reshape(fq * cs, k),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
     _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
 
 
@@ -457,11 +510,19 @@ def _conv2d_pallas(
                 _vmem_spec(),
                 _vmem_spec(),
             ]
-    else:  # "taps" (and "pairs" at fq == 1, where there is nothing to pair)
+    else:  # "taps"/"vcol" (and "pairs" at fq == 1, where there is nothing to pair)
         operands = (xs, ws2d, b)
-        kernel = functools.partial(_conv_kernel, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
+        kern_fn = _conv_vcol_kernel if variant == "vcol" else _conv_kernel
+        kernel = functools.partial(kern_fn, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
         kk = w.shape[-1]
-        if k_block and kk % k_block == 0 and kk > k_block:
+        # Mosaic constraint (measured on the real v5e, 2026-07-31): every
+        # blocked operand's minor dim is k_block, and the lane tiling is 128
+        # — a non-multiple (the env's 64 setting) cannot lower on chip
+        # ("block shape is a multiple of the tiling size"). Interpret mode
+        # has no tiling, so CI keeps exercising 64; on hardware the lever
+        # is silently off, same policy as K % k_block != 0.
+        k_block_ok = k_block % 128 == 0 or _interpret()
+        if k_block and kk % k_block == 0 and kk > k_block and k_block_ok:
             # Third grid dim over K blocks (the round-4 verdict's named
             # next lever): each program owns k_block output channels, so
             # the VMEM-resident weight slice and fp32 accumulator shrink
@@ -471,10 +532,17 @@ def _conv2d_pallas(
             # disjoint and per-element accumulation order is untouched —
             # bitwise identical to unblocked, like the rowblock lever.
             nk = kk // k_block
+            # Bias rides as (1, K) with a (1, k_block) block: a rank-1
+            # (k_block,) spec is illegal on chip — rank-1 tiling is
+            # 256 for bf16 (128 lanes x 2 packing), so a 128 block was
+            # rejected by the lowering. Rank-2 puts k_block on the lane
+            # dim where 128 is exactly the tile. The epilogue's
+            # broadcast add is rank-agnostic.
+            operands = (xs, ws2d, b.reshape(1, kk))
             in_specs = [
                 _vmem_spec((1, hs, ws, cs), lambda i, j, k: (i, 0, 0, 0)),
                 _vmem_spec((fq, fq, cs, k_block), lambda i, j, k: (0, 0, 0, k)),
-                _vmem_spec((k_block,), lambda i, j, k: (k,)),
+                _vmem_spec((1, k_block), lambda i, j, k: (0, k)),
             ]
             out = pl.pallas_call(
                 kernel,

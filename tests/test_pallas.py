@@ -258,8 +258,9 @@ def test_conv_pairs_variant_matches_taps(monkeypatch):
 
 
 def test_conv_row_block_variant_bitwise(monkeypatch):
-    """TPU_FRAMEWORK_ROWBLOCK=16/32 changes only the grid tiling, not the
-    per-output accumulation order -> bitwise identical to the default 8."""
+    """TPU_FRAMEWORK_ROWBLOCK changes only the grid tiling, not the
+    per-output accumulation order -> every setting is bitwise identical
+    (default is 64 since the 2026-07-31 on-chip sweep adopted it)."""
     import numpy as np
 
     from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
@@ -268,10 +269,40 @@ def test_conv_row_block_variant_bitwise(monkeypatch):
     w = jax.random.normal(jax.random.PRNGKey(6), (11, 11, 3, 16)) * 0.1
     b = jnp.zeros((16,))
     monkeypatch.delenv("TPU_FRAMEWORK_ROWBLOCK", raising=False)
-    r8 = np.asarray(conv2d_pallas(x, w, b, stride=4))
-    for rb in ("16", "32"):
+    rdef = np.asarray(conv2d_pallas(x, w, b, stride=4))
+    for rb in ("8", "16", "32"):
         monkeypatch.setenv("TPU_FRAMEWORK_ROWBLOCK", rb)
-        np.testing.assert_array_equal(np.asarray(conv2d_pallas(x, w, b, stride=4)), r8)
+        np.testing.assert_array_equal(np.asarray(conv2d_pallas(x, w, b, stride=4)), rdef)
+
+
+def test_conv_vcol_variant_matches_taps(monkeypatch):
+    """TPU_FRAMEWORK_CONV=vcol (in-kernel im2col over the qw taps — the
+    adopted round-5 default) agrees with the tap-loop lowering to
+    reduction-reorder tolerance at conv1-like (stride 4, fq=3) and
+    conv2-like (stride 1, fq=5) geometry, and is deterministic
+    within-variant."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(11), (2, 31, 31, 3))
+    w = jax.random.normal(jax.random.PRNGKey(12), (11, 11, 3, 16)) * 0.1
+    b = jnp.ones((16,)) * 0.1
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "taps")
+    taps = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "vcol")
+    vcol = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    vcol2 = np.asarray(conv2d_pallas(x, w, b, stride=4, relu=True))
+    np.testing.assert_allclose(vcol, taps, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(vcol, vcol2)  # deterministic
+
+    w5 = jax.random.normal(jax.random.PRNGKey(13), (5, 5, 3, 8)) * 0.1
+    b5 = jnp.zeros((8,))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "taps")
+    taps5 = np.asarray(conv2d_pallas(x, w5, b5, stride=1, padding=2))
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "vcol")
+    vcol5 = np.asarray(conv2d_pallas(x, w5, b5, stride=1, padding=2))
+    np.testing.assert_allclose(vcol5, taps5, rtol=1e-5, atol=1e-6)
 
 
 def test_conv_k_block_variant_bitwise(monkeypatch):
