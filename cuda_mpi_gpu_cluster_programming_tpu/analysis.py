@@ -984,13 +984,33 @@ def cmd_narrative(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
                     f"batch=1 cells ({lo_ms:.1f}-{hi_ms:.1f} ms/pass) spread "
                     f"{lo:.0%}-{hi:.0%}"
                     + (
-                        " — a session-level systematic shift the chain cannot "
-                        "average out, so b=1 latency is reported as a bound, "
-                        "not a claim."
+                        " — a shift the timing chain cannot average out, so "
+                        "b=1 latency is reported as a bound, not a claim."
                         if hi > bar
                         else f" (bar {bar:.0%} met)."
                     )
                 )
+            # The fresh-process diagnostic (on_heal.sh, three back-to-back
+            # runs of the worst cell in ONE session) attributes the b=1
+            # shift: spread within minutes ~ spread across sessions =>
+            # per-process dispatch/lowering variance, not device drift.
+            diag_path = Path("perf/b1_diag_latest.json")
+            if b1 and diag_path.exists():
+                try:
+                    dg = json.loads(diag_path.read_text())
+                    runs = dg.get("runs_ms", [])
+                    if runs:
+                        parts.append(
+                            f"Fresh-process diagnostic ({len(runs)} "
+                            f"back-to-back runs, {dg.get('source', '?')}): "
+                            f"{min(runs):.2f}-{max(runs):.2f} ms — "
+                            f"{dg.get('spread', 0):.0%} spread within minutes "
+                            "in one session, so the b=1 shift is per-process "
+                            "dispatch/lowering variance, not device or relay "
+                            "drift; the bound stands."
+                        )
+                except (OSError, ValueError):
+                    pass
             say(" ".join(parts))
             say("")
         except (OSError, ValueError):

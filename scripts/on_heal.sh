@@ -117,6 +117,13 @@ done
 # Summarize + judge the bar from THIS log (no-op rows -> error note only).
 timeout 120 python scripts/conv_ab_report.py "$LOG" 2>&1 | tee -a "$LOG"
 
+say "per-layer Pallas-vs-XLA attribution under the work-floor timer (review-fixed; the 03:18Z window's table used the naive chain timer and the chip wedged mid-rerun)"
+for comp in bf16 fp32; do
+    TPU_FRAMEWORK_ROWBLOCK=64 timeout 1200 \
+        python scripts/v3_layer_ab.py --compute $comp 2>&1 \
+        | grep -vE "WARNING" | tee -a "$LOG"
+done
+
 say "b=1 fresh-process repeatability diagnostic (3 back-to-back runs of the worst spread cell)"
 # The 2026-07-31 two-session spread check failed ONLY on b=1 cells (34-86%,
 # sessions 25 min apart, each case already a fresh process). Three

@@ -15,8 +15,8 @@ from __future__ import annotations
 
 import argparse
 import functools
+import statistics
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -35,17 +35,18 @@ from cuda_mpi_gpu_cluster_programming_tpu.ops import reference as ref_ops
 
 
 def _time(fn, *args, repeats: int) -> float:
-    """Median-of-3 amortized ms per call (chain of `repeats` fenced calls)."""
+    """Median per-call ms under the repo's work-floor protocol
+    (utils/timing.py amortized_stats: two-queue-length differencing with a
+    D2H fence, chain grown to the >=100 ms work floor — plain
+    block_until_ready chains are RTT-shadowed through the tunneled relay
+    and must not be trusted; review finding, 2026-07-31).  ``repeats``
+    seeds the small queue length; the protocol grows the chain as needed."""
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.timing import amortized_stats
+
     f = jax.jit(fn)
     jax.block_until_ready(f(*args))  # compile outside the clock
-    samples = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(repeats):
-            out = f(*args)
-        jax.block_until_ready(out)
-        samples.append((time.perf_counter() - t0) / repeats * 1e3)
-    return sorted(samples)[1]
+    st = amortized_stats(f, *args, n_small=10, n_large=10 + repeats)
+    return statistics.median(st.samples_ms)
 
 
 def main() -> int:
@@ -74,11 +75,20 @@ def main() -> int:
         )
 
     def conv_xla(x, w, b, spec):
+        # Precision must match the Pallas side's _mxu_precision (fp32 ->
+        # HIGHEST = true fp32 via 6 bf16 MXU passes; default would round
+        # operands to bf16 and make the fp32 column ~6x too fast — review
+        # finding, 2026-07-31). bf16 stays DEFAULT on both sides.
         out = lax.conv_general_dilated(
             x, w, (spec.stride, spec.stride),
             [(spec.padding, spec.padding)] * 2,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             preferred_element_type=jnp.float32,
+            precision=(
+                lax.Precision.HIGHEST
+                if x.dtype == jnp.float32
+                else lax.Precision.DEFAULT
+            ),
         )
         return jnp.maximum(out + b, 0.0).astype(x.dtype)
 

@@ -265,7 +265,11 @@ def test_conv_row_block_variant_bitwise(monkeypatch):
 
     from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import conv2d_pallas
 
-    x = jax.random.normal(jax.random.PRNGKey(5), (1, 67, 67, 3))
+    # Tall-narrow input: ho = (267-11)/4+1 = 65, so 8/16/32/64 produce
+    # genuinely different grids (nbh 9/5/3/2) — a square 67x67 input
+    # (ho=15) silently clamped 16/32/64 to the same single-block lowering
+    # and compared a kernel to itself (review finding, 2026-07-31).
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 267, 31, 3))
     w = jax.random.normal(jax.random.PRNGKey(6), (11, 11, 3, 16)) * 0.1
     b = jnp.zeros((16,))
     monkeypatch.delenv("TPU_FRAMEWORK_ROWBLOCK", raising=False)
