@@ -118,8 +118,15 @@ def env_variant(env_name: str, default: str, allowed: tuple) -> str:
 #             img/s, 0.42x v1_jit, from taps' 0.38x) and fp32 11.003 ms
 #             (11.6k img/s, 0.53x v1_jit — the first tier/compute cell
 #             to clear the 0.5x adoption bar).
+#   "g8"    — phase-packed conv for strided convs (s>=2; s=1 falls back
+#             to vcol): space-to-depth at g=2s puts g*g*C channels on the
+#             lanes (conv1: 192 vs 48) and computes the 2x2 output phases
+#             on separate grid programs (see _conv_g8_kernel). Round-5
+#             named lever targeting conv1's measured data-movement bound;
+#             coded + CPU-verified against a wedged chip, on-chip
+#             lowering proof and A/B queued in scripts/on_heal.sh.
 def _conv_variant() -> str:
-    return env_variant("TPU_FRAMEWORK_CONV", "vcol", ("taps", "pairs", "fused", "vcol"))
+    return env_variant("TPU_FRAMEWORK_CONV", "vcol", ("taps", "pairs", "fused", "vcol", "g8"))
 
 
 # Default output rows per conv program (TPU_FRAMEWORK_ROWBLOCK overrides).
@@ -341,6 +348,70 @@ def _conv_vcol_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int
     _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
 
 
+def _conv_g8_kernel(x_ref, w_ref, b_ref, o_ref, *, fq8: int, bh: int, wo_p: int, relu: bool):
+    """Phase-packed conv (the round-5 verdict-protocol 'next lever' for
+    conv1): x_ref (1, hs8, ws8, G) is the input space-to-depth-packed at
+    g = 2*stride (G = g*g*C — conv1: 192 lanes vs the stride-s packing's
+    48), w_ref (1, 1, fq8, fq8, G, K) is THIS program's phase weight
+    frame, o_ref (1, 1, 1, bh, wo_p, K) is one of the 2x2 output phases
+    (out row j = 2a + phase_h; the de-interleave is a host-side XLA
+    transpose). Grid (N, 2, 2). Every phase-output row a reads g-rows
+    [a, a+fq8) regardless of phase — the phase's intra-block offset lives
+    entirely in the zero-padded weight frame — so the kernel body is the
+    vcol lowering at 4x the lane occupancy and fq8 (=2 for conv1) taps
+    per axis instead of fq (=3)."""
+    gch = x_ref.shape[-1]
+    k = w_ref.shape[-1]
+    prec = _mxu_precision(x_ref.dtype)
+    acc = jnp.zeros((bh * wo_p, k), jnp.float32)
+    for qh in range(fq8):
+        wide = jnp.concatenate(
+            [
+                x_ref[0, qh : qh + bh, qw : qw + wo_p, :].reshape(bh * wo_p, gch)
+                for qw in range(fq8)
+            ],
+            axis=-1,
+        )
+        acc = acc + jnp.dot(
+            wide,
+            w_ref[0, 0, qh].reshape(fq8 * gch, k),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
+    # Shared epilogue via a phase sub-ref (o_ref.at[0, 0] drops the two
+    # leading unit dims so _conv_epilogue's o_ref[0] write lands on
+    # [0, 0, 0]) — the one-place invariant holds across all variants.
+    _conv_epilogue(acc, b_ref, o_ref.at[0, 0], bh=bh, wo_p=wo_p, k=k, relu=relu)
+
+
+def _weights_to_phase_depth(w: jax.Array, s: int, g: int, fq8: int) -> jax.Array:
+    """(F, F, C, K) -> (2, 2, fq8, fq8, g*g*C, K) phase weight frames.
+
+    Phase (ph, pw) of the g = 2s packing sees the filter at spatial offset
+    (ph*s, pw*s) inside its fq8*g-wide zero frame; the frame is then
+    depth-packed with the same (g_h, g_w, c) channel order as
+    :func:`_space_to_depth`, so frame row v = ph*s + u lands at tap v//g,
+    channel block v%g — exactly where input row j*s + u sits in xs8."""
+    f, _, c, k = w.shape
+    frames = []
+    for ph in range(2):
+        row = []
+        for pw in range(2):
+            wp = jnp.pad(
+                w,
+                (
+                    (ph * s, fq8 * g - f - ph * s),
+                    (pw * s, fq8 * g - f - pw * s),
+                    (0, 0),
+                    (0, 0),
+                ),
+            )
+            wp = wp.reshape(fq8, g, fq8, g, c, k)
+            row.append(wp.transpose(0, 2, 1, 3, 4, 5).reshape(fq8, fq8, g * g * c, k))
+        frames.append(jnp.stack(row))
+    return jnp.stack(frames)
+
+
 def _space_to_depth(x: jax.Array, s: int, hs: int, ws: int) -> jax.Array:
     """(N, H, W, C) -> (N, hs, ws, s*s*C); H, W zero-padded to hs*s, ws*s.
 
@@ -440,6 +511,49 @@ def _conv2d_pallas(
 
     if ph or pw:
         x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+    if variant == "g8" and s >= 2:
+        # Phase-packed lowering (round-5 'next lever', per-layer A/B
+        # attribution in docs/PALLAS_PERF.md): repack at g = 2s so the
+        # lane dim carries g*g*C channels (conv1: 192 vs 48 — the vcol
+        # kernel's window relayouts ran at 37% lane occupancy, which the
+        # A/B measured as conv1's dominating cost), compute the 2x2
+        # output phases on separate grid programs, and de-interleave with
+        # one host-side XLA transpose of the (smaller) output.
+        g = 2 * s
+        fq8 = -(-(f + s) // g)       # g-taps per axis, max over phases
+        ho2, wo2 = -(-ho // 2), -(-wo // 2)
+        wo2_p = -(-wo2 // _W_ALIGN) * _W_ALIGN
+        bh8 = ho2                    # whole phase image per program
+        hs8, ws8 = bh8 + fq8 - 1, wo2_p + fq8 - 1
+        xs8 = _space_to_depth(x, g, hs8, ws8)
+        w8 = _weights_to_phase_depth(w, s, g, fq8)
+        gch = g * g * c
+        kk = w.shape[-1]
+        out8 = pl.pallas_call(
+            functools.partial(
+                _conv_g8_kernel, fq8=fq8, bh=bh8, wo_p=wo2_p, relu=relu
+            ),
+            grid=(n, 2, 2),
+            in_specs=[
+                _vmem_spec((1, hs8, ws8, gch), lambda i, u, v: (i, 0, 0, 0)),
+                _vmem_spec(
+                    (1, 1, fq8, fq8, gch, kk),
+                    lambda i, u, v: (u, v, 0, 0, 0, 0),
+                ),
+                _vmem_spec(),
+            ],
+            out_specs=_vmem_spec(
+                (1, 1, 1, bh8, wo2_p, kk), lambda i, u, v: (i, u, v, 0, 0, 0)
+            ),
+            out_shape=vma_struct((n, 2, 2, bh8, wo2_p, kk), x.dtype, vma),
+            compiler_params=_tc_params("parallel", "parallel", "parallel"),
+            interpret=_interpret(),
+        )(xs8, w8, b)
+        # out[j, l] = out8[j%2, l%2, j//2, l//2]: interleave rows/cols by
+        # phase, then crop the alignment padding (it lands past ho/wo).
+        out = out8.transpose(0, 3, 1, 4, 2, 5).reshape(n, 2 * bh8, 2 * wo2_p, kk)
+        return out[:, :ho, :wo, :]
     # Round the output tile up to (row-block, sublane-aligned W); the extra
     # rows/cols read zero padding and are cropped after the call. Cheap:
     # <= _W_ALIGN-1 wasted columns, <= row_block-1 wasted rows.
@@ -512,7 +626,7 @@ def _conv2d_pallas(
             ]
     else:  # "taps"/"vcol" (and "pairs" at fq == 1, where there is nothing to pair)
         operands = (xs, ws2d, b)
-        kern_fn = _conv_vcol_kernel if variant == "vcol" else _conv_kernel
+        kern_fn = _conv_vcol_kernel if variant in ("vcol", "g8") else _conv_kernel
         kernel = functools.partial(kern_fn, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
         kk = w.shape[-1]
         # Mosaic constraint (measured on the real v5e, 2026-07-31): every

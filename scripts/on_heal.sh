@@ -117,6 +117,36 @@ done
 # Summarize + judge the bar from THIS log (no-op rows -> error note only).
 timeout 120 python scripts/conv_ab_report.py "$LOG" 2>&1 | tee -a "$LOG"
 
+say "g8 phase-packed conv: first-ever Mosaic lowering + correctness on chip, then the adoption A/B (round-5 named lever, coded blind against a wedged chip)"
+if timeout 600 python - >>"$LOG" 2>&1 <<'EOF'
+import jax, numpy as np, jax.numpy as jnp
+from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_kernels as pk
+k = jax.random.PRNGKey(0)
+for dt in (jnp.bfloat16, jnp.float32):
+    x = jax.random.normal(k, (4, 227, 227, 3), dt)
+    w = (jax.random.normal(k, (11, 11, 3, 96), jnp.float32) * 0.05).astype(dt)
+    b = jax.random.normal(k, (96,), dt)
+    ot = np.asarray(pk.conv2d_pallas(x, w, b, stride=4, relu=True, variant="vcol").astype(jnp.float32))
+    og = np.asarray(pk.conv2d_pallas(x, w, b, stride=4, relu=True, variant="g8").astype(jnp.float32))
+    d = float(np.max(np.abs(ot - og)) / np.max(np.abs(ot)))
+    tol = 3e-2 if dt == jnp.bfloat16 else 1e-5
+    print(np.dtype(dt).name, "g8 rel diff", d)
+    assert d < tol
+print("g8 lowering+correctness OK on", jax.devices()[0].platform)
+EOF
+then
+    echo "g8 on-chip correctness OK" | tee -a "$LOG"
+    for comp in bf16 fp32; do
+        TPU_FRAMEWORK_CONV=g8 timeout 600 \
+            python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+            --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
+            | grep "completed in" \
+            | sed "s/^/conv=g8 rb=64 kb=0 $comp /" | tee -a "$LOG"
+    done
+else
+    say "g8 FAILED to lower or mismatched on chip — see $LOG; A/B skipped (vcol default stands)"
+fi
+
 say "per-layer Pallas-vs-XLA attribution under the work-floor timer (review-fixed; the 03:18Z window's table used the naive chain timer and the chip wedged mid-rerun)"
 for comp in bf16 fp32; do
     TPU_FRAMEWORK_ROWBLOCK=64 timeout 1200 \
