@@ -1001,19 +1001,23 @@ def cmd_narrative(conn: sqlite3.Connection, out: Path, baseline: str) -> None:
                     runs = dg.get("runs_ms", [])
                     if runs:
                         dspread = dg.get("spread", 0)
-                        # Decision rule (scripts/on_heal.sh): loose
-                        # back-to-back = per-process variance; tight
-                        # back-to-back + loose across sessions = device/
-                        # relay drift. Don't bake one conclusion in.
+                        # Decision rule (scripts/on_heal.sh): the
+                        # back-to-back spread must be compared against
+                        # the OBSERVED cross-session b=1 spread (hi), not
+                        # a fixed bar — comparable = per-process
+                        # variance explains the shift; much tighter =
+                        # the shift happens BETWEEN sessions (device/
+                        # relay state drift).
                         verdict = (
-                            "loose within minutes in one session, so the "
-                            "b=1 shift is per-process dispatch/lowering "
-                            "variance, not device or relay drift; the "
-                            "bound stands."
-                            if dspread > bar
-                            else "tight back-to-back, so the cross-session "
-                            "b=1 shift points at device/relay state drift "
-                            "between sessions; the bound stands."
+                            f"comparable to the {hi:.0%} cross-session "
+                            "shift, so the b=1 instability is per-process "
+                            "dispatch/lowering variance, not device or "
+                            "relay drift; the bound stands."
+                            if dspread >= hi / 2
+                            else f"far tighter than the {hi:.0%} "
+                            "cross-session shift, which therefore points "
+                            "at device/relay state drift between "
+                            "sessions; the bound stands."
                         )
                         parts.append(
                             f"Fresh-process diagnostic ({len(runs)} "

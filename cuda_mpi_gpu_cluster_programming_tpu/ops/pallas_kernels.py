@@ -162,6 +162,16 @@ def _k_block() -> int:
     return int(env_variant("TPU_FRAMEWORK_KBLOCK", "0", ("0", "64", "128")))
 
 
+# Epilogue fusion (round-5 lever): "hpool" fuses the separable pool's H
+# stage into the conv epilogue where the model's conv feeds a pool (the
+# full-height conv output never round-trips HBM; the pool's first kernel
+# launch disappears). Bitwise-neutral (exact max on the casted value).
+# Applies where the geometry allows (taps/vcol, row_block >= ho); the
+# model builder falls back to the separate pool otherwise.
+def _fuse_variant() -> str:
+    return env_variant("TPU_FRAMEWORK_FUSE", "none", ("none", "hpool"))
+
+
 class KernelVariants(NamedTuple):
     """Resolved lowering-variant set — hashable, so it can ride jit static
     args. ``resolve()`` reads the environment ONCE; build-time callers
@@ -175,12 +185,13 @@ class KernelVariants(NamedTuple):
     pool: str = "sep2"
     row_block: int = _ROW_BLOCK
     k_block: int = 0
+    fuse: str = "none"
 
     @classmethod
     def resolve(cls) -> "KernelVariants":
         return cls(
             conv=_conv_variant(), pool=_pool_variant(), row_block=_row_block(),
-            k_block=_k_block(),
+            k_block=_k_block(), fuse=_fuse_variant(),
         )
 
 
@@ -191,13 +202,40 @@ def _mxu_precision(dtype):
     return lax.Precision.HIGHEST if dtype == jnp.float32 else lax.Precision.DEFAULT
 
 
-def _conv_epilogue(acc, b_ref, o_ref, *, bh: int, wo_p: int, k: int, relu: bool):
+def _conv_epilogue(acc, b_ref, o_ref, *, bh: int, wo_p: int, k: int, relu: bool,
+                   hpool=None):
     """Shared bias + optional-ReLU + cast tail of both conv variants —
-    one place, so the variants cannot diverge numerically in the epilogue."""
+    one place, so the variants cannot diverge numerically in the epilogue.
+
+    ``hpool=(window, stride, hp_o)`` (round-5 fusion lever): additionally
+    max-pool the H axis in-kernel before the write, so the full-height
+    conv output never round-trips HBM and the separable pool's first
+    stage disappears. Requires the whole image in one program (bh == ho).
+    The pool runs on the CASTED value — exactly the tensor the unfused
+    sep2 H-stage would read back — and the row phase-split is a reshape
+    of the leading UNTILED axis (the tiled (W, C) dims are untouched), so
+    the result is bitwise identical to conv-then-pool
+    (tests/test_pallas.py::test_conv_hpool_fusion_bitwise)."""
     out = acc.reshape(bh, wo_p, k) + b_ref[:].astype(jnp.float32)
     if relu:
         out = jnp.maximum(out, 0.0)
-    o_ref[0] = out.astype(o_ref.dtype)
+    out = out.astype(o_ref.dtype)
+    if hpool is not None:
+        window, stride, hp_o = hpool
+        qmax = (window - 1) // stride
+        hq = hp_o + qmax           # H view-rows the pool reads
+        if bh < hq * stride:       # pad rows never entering a window
+            out = jnp.concatenate(
+                [out, jnp.zeros((hq * stride - bh, wo_p, k), out.dtype)], axis=0
+            )
+        u = out[: hq * stride].reshape(hq, stride, wo_p, k)
+        res = None
+        for fy in range(window):
+            q, p = fy // stride, fy % stride
+            win = u[q : q + hp_o, p]
+            res = win if res is None else jnp.maximum(res, win)
+        out = res
+    o_ref[0] = out
 
 
 def _conv_fused_kernel(x_ref, w_ref, b_ref, o_ref, *, bh: int, wo_p: int, relu: bool):
@@ -271,7 +309,7 @@ def _conv_pairs_even_kernel(
     _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=wp_ref.shape[-1], relu=relu)
 
 
-def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, relu: bool):
+def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, relu: bool, hpool=None):
     """Space-to-depth conv: x_ref (1, Hs, Ws, S*S*C), w_ref (fq, fq, S*S*C, K).
 
     Program (i, j) computes output rows [j*bh, (j+1)*bh) of image i. Every
@@ -303,10 +341,10 @@ def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, rel
                 preferred_element_type=jnp.float32,
                 precision=prec,
             )
-    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
+    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu, hpool=hpool)
 
 
-def _conv_vcol_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, relu: bool):
+def _conv_vcol_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int, relu: bool, hpool=None):
     """VMEM-level im2col over the qw taps (round-5 lever, named from the
     per-layer A/B in scripts/v3_layer_ab.py): same operands and HBM
     traffic as "taps" (1x input), but the fq qw-windows are concatenated
@@ -345,7 +383,7 @@ def _conv_vcol_kernel(x_ref, w_ref, b_ref, o_ref, *, fq: int, bh: int, wo_p: int
             preferred_element_type=jnp.float32,
             precision=prec,
         )
-    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu)
+    _conv_epilogue(acc, b_ref, o_ref, bh=bh, wo_p=wo_p, k=k, relu=relu, hpool=hpool)
 
 
 def _conv_g8_kernel(x_ref, w_ref, b_ref, o_ref, *, fq8: int, bh: int, wo_p: int, relu: bool):
@@ -451,11 +489,15 @@ def conv2d_pallas(
     variant: str | None = None,
     row_block: int | None = None,
     k_block: int | None = None,
+    hpool: tuple | None = None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU) — thin wrapper resolving the
     lowering variant (explicit arg wins; env var otherwise) before entering
     jit. ``vma``: mesh axes the call varies over inside a check_vma=True
-    shard_map (ops.vma)."""
+    shard_map (ops.vma). ``hpool=(window, stride)``: fuse the separable
+    pool's H stage into the conv epilogue (requires variant taps/vcol and
+    row_block >= the conv's output height; see _conv_epilogue) — the
+    result has pooled H, full W; run :func:`maxpool_pallas_w` after."""
     return _conv2d_pallas(
         x, w, b, stride=stride, padding=padding, padding_w=padding_w,
         relu=relu,
@@ -463,6 +505,7 @@ def conv2d_pallas(
         row_block=row_block if row_block is not None else _row_block(),
         k_block=k_block if k_block is not None else _k_block(),
         vma=tuple(vma) if vma is not None else None,
+        hpool=hpool,
     )
 
 
@@ -470,7 +513,7 @@ def conv2d_pallas(
     jax.jit,
     static_argnames=(
         "stride", "padding", "padding_w", "relu", "variant", "row_block",
-        "k_block", "vma",
+        "k_block", "vma", "hpool",
     ),
 )
 def _conv2d_pallas(
@@ -486,6 +529,7 @@ def _conv2d_pallas(
     row_block: int = _ROW_BLOCK,
     k_block: int = 0,
     vma=None,
+    hpool: tuple | None = None,
 ) -> jax.Array:
     """Direct conv (+bias, optional fused ReLU). x: (N,H,W,C), w: (F,F,C,K).
 
@@ -500,6 +544,10 @@ def _conv2d_pallas(
     all unit-stride and each matmul contracts over S*S*C. For S=1 this
     degenerates to the identity packing.
     """
+    if hpool is not None and variant not in ("taps", "vcol"):
+        raise ValueError(
+            f"hpool fusion supports the taps/vcol lowering only, got {variant!r}"
+        )
     n, h, wdt, c = x.shape
     f = w.shape[0]
     s = stride
@@ -629,6 +677,39 @@ def _conv2d_pallas(
         kern_fn = _conv_vcol_kernel if variant in ("vcol", "g8") else _conv_kernel
         kernel = functools.partial(kern_fn, fq=fq, bh=bh, wo_p=wo_p, relu=relu)
         kk = w.shape[-1]
+        if hpool is not None:
+            # Fused H-stage pool (see _conv_epilogue): caller contract, not
+            # a silent fallback — the model builder gates on these.
+            if bh != ho:
+                raise ValueError(
+                    f"hpool fusion needs the whole image per program "
+                    f"(row_block {row_block} < ho {ho})"
+                )
+            if k_block:
+                raise ValueError(
+                    "hpool fusion does not compose with k_block (the fused "
+                    "path has no K grid dim); unset one of them"
+                )
+            pwin, pstr = hpool
+            hp_o = (ho - pwin) // pstr + 1
+            kernel = functools.partial(
+                kern_fn, fq=fq, bh=bh, wo_p=wo_p, relu=relu,
+                hpool=(pwin, pstr, hp_o),
+            )
+            out = pl.pallas_call(
+                kernel,
+                grid=(n, 1),
+                in_specs=[
+                    _vmem_spec((1, hs, ws, cs), lambda i, j: (i, 0, 0, 0)),
+                    _vmem_spec(),
+                    _vmem_spec(),
+                ],
+                out_specs=_vmem_spec((1, hp_o, wo_p, kk), lambda i, j: (i, j, 0, 0)),
+                out_shape=vma_struct((n, hp_o, wo_p, kk), x.dtype, vma),
+                compiler_params=_tc_params("parallel", "parallel"),
+                interpret=_interpret(),
+            )(*operands)
+            return out[:, :, :wo, :]
         # Mosaic constraint (measured on the real v5e, 2026-07-31): every
         # blocked operand's minor dim is k_block, and the lane tiling is 128
         # — a non-multiple (the env's 64 setting) cannot lower on chip
@@ -826,6 +907,17 @@ def _maxpool_sep2(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Ar
     yt = jnp.swapaxes(y, 1, 2)                           # (N, W, ho, C)
     z = _pool_rows(yt, window=window, stride=stride, vma=vma)  # (N, wo, ho, C)
     return jnp.swapaxes(z, 1, 2)                         # (N, ho, wo, C)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "stride", "vma"))
+def maxpool_pallas_w(x: jax.Array, *, window: int, stride: int, vma=None) -> jax.Array:
+    """W-axis-only pool stage — the second half of the separable pool, for
+    outputs whose H stage was already fused into the conv epilogue
+    (``conv2d_pallas(..., hpool=...)``). Same kernel, same numerics as the
+    sep2 W stage, so fused-conv + this == conv + maxpool_pallas bitwise."""
+    yt = jnp.swapaxes(x, 1, 2)                           # (N, W, hpooled, C)
+    z = _pool_rows(yt, window=window, stride=stride, vma=vma)
+    return jnp.swapaxes(z, 1, 2)
 
 
 def _lrn_kernel(x_ref, o_ref, *, size: int, alpha: float, beta: float, k: float, alpha_over_size: bool):

@@ -44,6 +44,36 @@ def _pad_axis(a: jax.Array, axis: int, to: int) -> jax.Array:
     return jnp.pad(a, widths)
 
 
+def _conv_then_pool(x, w, b, cspec, pspec, v: "pk.KernelVariants"):
+    """conv(+relu) then max-pool, the ONE place that decides whether the
+    pool's H stage rides the conv epilogue (``fuse="hpool"``) — both
+    forward builders route conv->pool adjacencies through here, so the
+    geometry gate cannot drift between paths. Gate: taps/vcol lowering,
+    sep2 pool, whole image per program, and no K-blocking (the fused path
+    has no K grid dim — conv2d_pallas raises on that combination rather
+    than silently dropping a lever). Bitwise identical either way
+    (_conv_epilogue)."""
+    ho = (x.shape[1] + 2 * cspec.padding - cspec.filter_size) // cspec.stride + 1
+    if (
+        v.fuse == "hpool"
+        and v.conv in ("taps", "vcol")
+        and v.pool == "sep2"
+        and v.row_block >= ho
+        and v.k_block == 0
+    ):
+        y = pk.conv2d_pallas(
+            x, w, b, stride=cspec.stride, padding=cspec.padding, relu=True,
+            variant=v.conv, row_block=v.row_block, k_block=0,
+            hpool=(pspec.window, pspec.stride),
+        )
+        return pk.maxpool_pallas_w(y, window=pspec.window, stride=pspec.stride)
+    y = pk.conv2d_pallas(
+        x, w, b, stride=cspec.stride, padding=cspec.padding, relu=True,
+        variant=v.conv, row_block=v.row_block, k_block=v.k_block,
+    )
+    return pk.maxpool_pallas(y, window=pspec.window, stride=pspec.stride, variant=v.pool)
+
+
 def forward_blocks12_pallas(
     params,
     x: jax.Array,
@@ -65,17 +95,8 @@ def forward_blocks12_pallas(
         kp = -(-w1.shape[-1] // 128) * 128  # conv1 output channels -> 128
         w1, b1 = _pad_axis(w1, 3, kp), _pad_axis(b1, 0, kp)
         w2 = _pad_axis(w2, 2, kp)  # conv2 contraction axis: zero rows
-    conv = lambda x, w, b, s: pk.conv2d_pallas(  # noqa: E731
-        x, w, b, stride=s.stride, padding=s.padding, relu=True,
-        variant=v.conv, row_block=v.row_block, k_block=v.k_block,
-    )
-    pool = lambda x, s: pk.maxpool_pallas(  # noqa: E731
-        x, window=s.window, stride=s.stride, variant=v.pool
-    )
-    x = conv(x, w1, b1, c1)
-    x = pool(x, p1)
-    x = conv(x, w2, b2, c2)
-    x = pool(x, p2)
+    x = _conv_then_pool(x, w1, b1, c1, p1, v)
+    x = _conv_then_pool(x, w2, b2, c2, p2, v)
     x = pk.lrn_pallas(
         x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
     )
@@ -96,8 +117,21 @@ def forward_alexnet_pallas(
 
     cfg = cfg or ALEXNET
     v = variants if variants is not None else pk.KernelVariants.resolve()
-    for name, spec in cfg.layer_chain():
+    chain = list(cfg.layer_chain())
+    skip_pool_idx = -1
+    for idx, (name, spec) in enumerate(chain):
+        if idx == skip_pool_idx:
+            continue  # this pool was consumed by _conv_then_pool
         if isinstance(spec, ConvSpec):
+            nxt = chain[idx + 1][1] if idx + 1 < len(chain) else None
+            if isinstance(nxt, PoolSpec):
+                # conv->pool adjacency: the shared helper owns the
+                # fuse="hpool" decision (one gate for both builders).
+                x = _conv_then_pool(
+                    x, params[name]["w"], params[name]["b"], spec, nxt, v
+                )
+                skip_pool_idx = idx + 1
+                continue
             x = pk.conv2d_pallas(
                 x,
                 params[name]["w"],

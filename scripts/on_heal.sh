@@ -147,6 +147,42 @@ else
     say "g8 FAILED to lower or mismatched on chip — see $LOG; A/B skipped (vcol default stands)"
 fi
 
+say "hpool epilogue-fusion: first-ever Mosaic lowering + bitwise check on chip, then the A/B (same probe-before-measure policy as g8)"
+if timeout 600 python - >>"$LOG" 2>&1 <<'EOF'
+import jax, numpy as np, jax.numpy as jnp
+from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_kernels as pk
+k = jax.random.PRNGKey(0)
+for dt in (jnp.bfloat16, jnp.float32):
+    x = jax.random.normal(k, (4, 227, 227, 3), dt)
+    w = (jax.random.normal(k, (11, 11, 3, 96), jnp.float32) * 0.05).astype(dt)
+    b = jax.random.normal(k, (96,), dt)
+    ref = pk.maxpool_pallas(
+        pk.conv2d_pallas(x, w, b, stride=4, relu=True, variant="vcol", row_block=64),
+        window=3, stride=2)
+    fus = pk.maxpool_pallas_w(
+        pk.conv2d_pallas(x, w, b, stride=4, relu=True, variant="vcol", row_block=64,
+                         hpool=(3, 2)),
+        window=3, stride=2)
+    same = bool((np.asarray(ref.astype(jnp.float32)) == np.asarray(fus.astype(jnp.float32))).all())
+    print(np.dtype(dt).name, "hpool bitwise on chip:", same)
+    assert same
+print("hpool lowering+bitwise OK on", jax.devices()[0].platform)
+EOF
+then
+    echo "hpool on-chip bitwise OK" | tee -a "$LOG"
+    for comp in bf16 fp32; do
+        for fuse in none hpool; do
+            TPU_FRAMEWORK_FUSE=$fuse timeout 600 \
+                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
+                | grep "completed in" \
+                | sed "s/^/fuse=$fuse conv=vcol rb=64 $comp /" | tee -a "$LOG"
+        done
+    done
+else
+    say "hpool FAILED to lower or mismatched on chip — see $LOG; A/B skipped (fuse=none default stands)"
+fi
+
 say "per-layer Pallas-vs-XLA attribution under the work-floor timer (review-fixed; the 03:18Z window's table used the naive chain timer and the chip wedged mid-rerun)"
 for comp in bf16 fp32; do
     TPU_FRAMEWORK_ROWBLOCK=64 timeout 1200 \
