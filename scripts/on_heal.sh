@@ -94,29 +94,6 @@ fi
 
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
 
-say "conv variant A/B on the real chip: taps/pairs x rowblock 8/16/32 x kblock 0/128 (rounds-4/5 MXU-fill levers)"
-# Runs BEFORE the attention A/B since the 01:37Z re-wedge: this is the
-# adoption-gating measurement (v3_pallas bf16 >= 0.5x v1_jit at b=128,
-# carried since round 3) and the next window may be short. bf16 first for
-# the same reason — the bar is a bf16 bar. kblock (round-5, third lever)
-# applies to the taps path only; conv2's K=256 is the target (weight slice
-# + accumulator halve per program).
-for comp in bf16 fp32; do
-    for combo in "taps 0" "taps 128" "pairs 0"; do
-        set -- $combo; conv=$1; kb=$2
-        for rb in 8 16 32; do
-            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb \
-            TPU_FRAMEWORK_KBLOCK=$kb timeout 600 \
-                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
-                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
-                | grep "completed in" \
-                | sed "s/^/conv=$conv rb=$rb kb=$kb $comp /" | tee -a "$LOG"
-        done
-    done
-done
-# Summarize + judge the bar from THIS log (no-op rows -> error note only).
-timeout 120 python scripts/conv_ab_report.py "$LOG" 2>&1 | tee -a "$LOG"
-
 say "g8 phase-packed conv: first-ever Mosaic lowering + correctness on chip, then the adoption A/B (round-5 named lever, coded blind against a wedged chip)"
 if timeout 600 python - >>"$LOG" 2>&1 <<'EOF'
 import jax, numpy as np, jax.numpy as jnp
@@ -182,6 +159,29 @@ then
 else
     say "hpool FAILED to lower or mismatched on chip — see $LOG; A/B skipped (fuse=none default stands)"
 fi
+
+say "conv variant A/B on the real chip: taps/pairs x rowblock 8/16/32 x kblock 0/128 (already measured 2026-07-31 — re-confirmation rows; runs AFTER the never-measured g8/hpool A/Bs)"
+# Runs BEFORE the attention A/B since the 01:37Z re-wedge: this is the
+# adoption-gating measurement (v3_pallas bf16 >= 0.5x v1_jit at b=128,
+# carried since round 3) and the next window may be short. bf16 first for
+# the same reason — the bar is a bf16 bar. kblock (round-5, third lever)
+# applies to the taps path only; conv2's K=256 is the target (weight slice
+# + accumulator halve per program).
+for comp in bf16 fp32; do
+    for combo in "taps 0" "taps 128" "pairs 0"; do
+        set -- $combo; conv=$1; kb=$2
+        for rb in 8 16 32; do
+            TPU_FRAMEWORK_CONV=$conv TPU_FRAMEWORK_ROWBLOCK=$rb \
+            TPU_FRAMEWORK_KBLOCK=$kb timeout 600 \
+                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
+                | grep "completed in" \
+                | sed "s/^/conv=$conv rb=$rb kb=$kb $comp /" | tee -a "$LOG"
+        done
+    done
+done
+# Summarize + judge the bar from THIS log (no-op rows -> error note only).
+timeout 120 python scripts/conv_ab_report.py "$LOG" 2>&1 | tee -a "$LOG"
 
 say "per-layer Pallas-vs-XLA attribution under the work-floor timer (review-fixed; the 03:18Z window's table used the naive chain timer and the chip wedged mid-rerun)"
 for comp in bf16 fp32; do
