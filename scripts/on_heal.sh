@@ -25,6 +25,16 @@ if ! timeout 120 python -u -c "import jax; print((jax.numpy.ones((8,8))@jax.nump
 fi
 echo "${TS} OK (on_heal: queue started)" >> "$PROBE_LOG"
 
+# 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
+# timing once turned a ~30 s case into a 600 s timeout. If a test suite is
+# mid-flight when the window opens, wait it out (bounded) instead of
+# measuring into the contention.
+WAITED=0
+while pgrep -f "python -m pytest" >/dev/null 2>&1 && [ "$WAITED" -lt 1800 ]; do
+    [ "$WAITED" = 0 ] && say "pytest running — waiting for it to finish before timing (cap 30 min)"
+    sleep 30; WAITED=$((WAITED + 30))
+done
+
 say "vma-checker probe (first-ever real-TPU run of the check_vma=True tagged path)"
 # The tagged path can't execute in CI (interpret mode drops vma tags), so
 # probe it on a tiny sharded forward BEFORE spending the heal window: if
