@@ -1,0 +1,103 @@
+"""Serving-path throughput bench: tokens/sec through the KV-cache decoder.
+
+The LM serving path (models/transformer.py generate: one jitted lax.scan
+over time with per-layer KV caches, O(L) per token) has teacher-forced
+parity tests (tests/test_decode.py) but, until this script, no measured
+throughput anywhere — the train side has tok/s rows (examples/lm.py), the
+serve side had none. Reference parity note: the reference has no serving
+path at all (no attention, no decoder); this is beyond-reference evidence
+for the inference half of the train/serve matrix.
+
+Emits one JSON line per (batch, prompt, steps, dtype) cell:
+
+    {"metric": "lm_decode_tok_per_sec", "batch": ..., "prompt_len": ...,
+     "steps": ..., "dtype": ..., "tok_s": ..., "ms_per_step": ...,
+     "platform": "tpu", ...}
+
+tok_s counts GENERATED tokens only (batch * steps / wall), the serving
+number that matters; the prompt prefill rides the same scan (the decode
+scan replays the prompt teacher-forced), so ms_per_step (wall per scan
+step; a step emits `batch` tokens) includes the amortized prefill — stated rather than hidden.
+
+Timing: jit + one warm-up generate (compile excluded), then
+median-of-``--repeats`` fenced wall times of the whole generate call (one
+call is `steps` sequential scan iterations — hundreds of ms even at tiny
+shapes, far above the work floor, so the chain protocol is unnecessary).
+
+Usage:
+    python scripts/decode_bench.py                      # default grid
+    python scripts/decode_bench.py --batches 1,8 --steps 64 --repeats 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_cell(params, cfg, batch: int, plen: int, steps: int, repeats: int):
+    from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import generate
+
+    prompt = jnp.ones((batch, plen), jnp.int32)
+    run = jax.jit(
+        lambda p, t: generate(p, t, cfg, steps=steps), static_argnames=()
+    )
+    out = jax.block_until_ready(run(params, prompt))  # compile + warm-up
+    assert out.shape == (batch, plen + steps), out.shape
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(params, prompt))
+        samples.append(time.perf_counter() - t0)
+    wall = statistics.median(samples)
+    return {
+        "tok_s": round(batch * steps / wall, 1),
+        "ms_per_step": round(wall / steps * 1e3, 4),
+        "wall_ms": round(wall * 1e3, 2),
+        "timing_n": repeats,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="1,8,32")
+    ap.add_argument("--prompt", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=112)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--dtype", default="fp32", choices=["fp32", "bf16"])
+    args = ap.parse_args()
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.transformer import (
+        TINY_LM, init_transformer)
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    cfg = TINY_LM
+    params = init_transformer(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    plat = jax.devices()[0].platform
+    for b in [int(x) for x in args.batches.split(",")]:
+        cell = bench_cell(params, cfg, b, args.prompt, args.steps, args.repeats)
+        print(json.dumps({
+            "metric": "lm_decode_tok_per_sec",
+            "batch": b,
+            "prompt_len": args.prompt,
+            "steps": args.steps,
+            "dtype": args.dtype,
+            "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers,
+            "platform": plat,
+            **cell,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

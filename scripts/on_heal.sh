@@ -30,7 +30,7 @@ echo "${TS} OK (on_heal: queue started)" >> "$PROBE_LOG"
 # mid-flight when the window opens, wait it out (bounded) instead of
 # measuring into the contention.
 WAITED=0
-while pgrep -f "python -m pytest" >/dev/null 2>&1 && [ "$WAITED" -lt 1800 ]; do
+while pgrep -f pytest >/dev/null 2>&1 && [ "$WAITED" -lt 1800 ]; do
     [ "$WAITED" = 0 ] && say "pytest running — waiting for it to finish before timing (cap 30 min)"
     sleep 30; WAITED=$((WAITED + 30))
 done
@@ -199,6 +199,15 @@ for comp in bf16 fp32; do
         python scripts/v3_layer_ab.py --compute $comp 2>&1 \
         | grep -vE "WARNING" | tee -a "$LOG"
 done
+
+say "serving-path decode throughput (first-ever tok/s rows for the KV-cache generate scan)"
+for dt in bf16 fp32; do
+    # Full output to $LOG (tracebacks must survive a failed heal-window
+    # step); JSON rows additionally extracted into the perf artifact.
+    timeout 900 python scripts/decode_bench.py --dtype $dt 2>&1 | tee -a "$LOG" \
+        | grep '^{' >> perf/decode_bench_${FTS}.json
+done
+[ -s perf/decode_bench_${FTS}.json ] || say "decode bench produced no rows — see $LOG"
 
 say "b=1 fresh-process repeatability diagnostic (3 back-to-back runs of the worst spread cell)"
 # The 2026-07-31 two-session spread check failed ONLY on b=1 cells (34-86%,

@@ -134,3 +134,35 @@ def test_generate_with_tp_sharded_params():
         jax.jit(lambda p, pr: generate(p, pr, CFG, steps=12))(tp_params, prompt)
     )
     np.testing.assert_array_equal(got, ref)
+
+
+def test_decode_bench_script_smoke():
+    """scripts/decode_bench.py emits well-formed JSON rows on the CPU
+    backend (the chip queue runs the same script for the serving tok/s
+    evidence; this guards the script's import path and schema)."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.env_info import (
+        cpu_subprocess_env)
+
+    root = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "scripts" / "decode_bench.py"),
+         "--batches", "1", "--steps", "4", "--repeats", "1"],
+        capture_output=True, text=True, timeout=300,
+        cwd=root,
+        # CPU-forced child (single home for the axon-sitecustomize
+        # gotchas) — the ambient TPU registration would make this test
+        # hang whenever the tunnel is wedged.
+        env=cpu_subprocess_env(1),
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    rows = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["metric"] == "lm_decode_tok_per_sec"
+    assert r["batch"] == 1 and r["steps"] == 4
+    assert r["tok_s"] > 0 and r["ms_per_step"] > 0
