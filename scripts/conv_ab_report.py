@@ -28,7 +28,10 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 
 # combo prefix added by on_heal.sh's sed, then the run.py stdout contract.
+# The optional fuse= prefix carries the round-5 hpool epilogue-fusion A/B
+# rows (fuse=none|hpool conv=vcol rb=64 kb=0 ...).
 _LINE = re.compile(
+    r"(?:fuse=(?P<fuse>\w+) )?"
     r"conv=(?P<conv>\w+) rb=(?P<rb>\d+) kb=(?P<kb>\d+) (?P<compute>fp32|bf16) "
     r"AlexNet TPU Forward Pass completed in (?P<ms>[\d.]+) ms "
     r"\(amortized over \d+ fenced passes; (?P<ips>[\d.]+) img/s\)"
@@ -43,6 +46,7 @@ def parse(text: str) -> list[dict]:
                 "conv": m["conv"],
                 "rowblock": int(m["rb"]),
                 "kblock": int(m["kb"]),
+                "fuse": m["fuse"] or "none",
                 "compute": m["compute"],
                 "ms": float(m["ms"]),
                 "img_per_sec": float(m["ips"]),
@@ -76,15 +80,15 @@ def v1_reference() -> dict[str, float]:
 
 def report(rows: list[dict], ref: dict[str, float]) -> str:
     lines = [
-        "| conv | rowblock | kblock | compute | ms/pass | img/s | vs v1_jit |",
-        "|---|---|---|---|---|---|---|",
+        "| conv | rowblock | kblock | fuse | compute | ms/pass | img/s | vs v1_jit |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in sorted(rows, key=lambda r: (r["compute"], -r["img_per_sec"])):
         rv = ref.get(r["compute"])
         vs = f"{r['img_per_sec'] / rv:.2f}x" if rv else "n/a"
         lines.append(
-            f"| {r['conv']} | {r['rowblock']} | {r['kblock']} | {r['compute']} "
-            f"| {r['ms']:.3f} | {r['img_per_sec']:.0f} | {vs} |"
+            f"| {r['conv']} | {r['rowblock']} | {r['kblock']} | {r['fuse']} "
+            f"| {r['compute']} | {r['ms']:.3f} | {r['img_per_sec']:.0f} | {vs} |"
         )
     out = ["## Conv lever A/B (b=128, real chip)", "", *lines, ""]
     for tier in ("bf16", "fp32"):
@@ -95,7 +99,8 @@ def report(rows: list[dict], ref: dict[str, float]) -> str:
         rv = ref.get(tier)
         msg = (
             f"best {tier}: conv={best['conv']} rowblock={best['rowblock']} "
-            f"kblock={best['kblock']} -> {best['img_per_sec']:.0f} img/s"
+            f"kblock={best['kblock']} fuse={best['fuse']} "
+            f"-> {best['img_per_sec']:.0f} img/s"
         )
         if rv:
             ratio = best["img_per_sec"] / rv

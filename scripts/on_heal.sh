@@ -163,7 +163,7 @@ then
                 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
                 --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
                 | grep "completed in" \
-                | sed "s/^/fuse=$fuse conv=vcol rb=64 $comp /" | tee -a "$LOG"
+                | sed "s/^/fuse=$fuse conv=vcol rb=64 kb=0 $comp /" | tee -a "$LOG"
         done
     done
 else

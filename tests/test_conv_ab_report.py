@@ -17,25 +17,28 @@ SAMPLE = f"""\
 conv=taps rb=8 kb=0 bf16 {_PASS} 5.800 ms (amortized over 100 fenced passes; 22068.9 img/s)
 conv=taps rb=8 kb=0 fp32 {_PASS} 15.100 ms (amortized over 100 fenced passes; 8476.8 img/s)
 conv=pairs rb=16 kb=0 bf16 {_PASS} 2.100 ms (amortized over 100 fenced passes; 60952.4 img/s)
+fuse=hpool conv=vcol rb=64 kb=0 bf16 {_PASS} 2.500 ms (amortized over 100 fenced passes; 51200.0 img/s)
 unrelated line
 """
 
 
 def test_parse_extracts_combo_rows():
     rows = mod.parse(SAMPLE)
-    assert len(rows) == 3
+    assert len(rows) == 4
     assert rows[0] == {
-        "conv": "taps", "rowblock": 8, "kblock": 0, "compute": "bf16",
-        "ms": 5.8, "img_per_sec": 22068.9,
+        "conv": "taps", "rowblock": 8, "kblock": 0, "fuse": "none",
+        "compute": "bf16", "ms": 5.8, "img_per_sec": 22068.9,
     }
     assert rows[2]["conv"] == "pairs" and rows[2]["rowblock"] == 16
+    # The round-5 hpool A/B rows carry a fuse= prefix.
+    assert rows[3]["fuse"] == "hpool" and rows[3]["conv"] == "vcol"
 
 
 def test_report_ranks_and_judges_bar():
     rows = mod.parse(SAMPLE)
     text = mod.report(rows, {"bf16": 102461.8, "fp32": 21668.3})
     # Ranked: pairs (60952) above taps (22068) within bf16.
-    assert text.index("| pairs | 16 |") < text.index("| taps | 8 | 0 | bf16")
+    assert text.index("| pairs | 16 |") < text.index("| taps | 8 | 0 | none | bf16")
     # 60952/102462 = 0.59x -> bar met.
     assert "BAR MET" in text
     assert "0.59x" in text
