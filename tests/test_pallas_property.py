@@ -37,7 +37,7 @@ def _rand(key, shape):
     stride=st.integers(1, 4),
     padding=st.integers(0, 3),
     relu=st.booleans(),
-    variant=st.sampled_from(["taps", "fused"]),
+    variant=st.sampled_from(["taps", "fused", "vcol", "pairs", "g8"]),
 )
 def test_conv_matches_reference(h, w_dim, c, k, f, stride, padding, relu, variant):
     # Reject (regenerate) degenerate geometries instead of silently
