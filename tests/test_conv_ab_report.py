@@ -72,3 +72,26 @@ def test_v1_reference_rejects_mismatched_baseline(tmp_path, monkeypatch):
     for bad in ({**good, "config": "v3_pallas"}, {**good, "batch": 256}):
         perf.joinpath("bench_latest.json").write_text(json.dumps(bad))
         assert mod.v1_reference() == {}
+
+
+def test_v3_layer_ab_script_smoke():
+    """scripts/v3_layer_ab.py (per-layer Pallas-vs-XLA attribution, run by
+    the heal queue) emits its table on the CPU backend — guards the import
+    path, the amortized_stats wiring, and the stage list."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.env_info import (
+        cpu_subprocess_env)
+
+    root = Path(__file__).parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "scripts" / "v3_layer_ab.py"),
+         "--batch", "2", "--repeats", "2"],
+        capture_output=True, text=True, timeout=600, cwd=root,
+        env=cpu_subprocess_env(1),
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    for stage in ("conv1+relu", "pool1", "conv2+relu", "pool2", "lrn2", "TOTAL"):
+        assert stage in out.stdout, (stage, out.stdout[-400:])
