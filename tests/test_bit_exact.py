@@ -94,3 +94,47 @@ def test_cross_tier_gap_is_real_and_bounded(workload):
     becomes bitwise, the README claim can be upgraded."""
     _, _, single_xla, single_pallas = workload
     assert np.allclose(single_pallas, single_xla, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+def test_pallas_tier_sharding_under_g8(workload, monkeypatch, n):
+    """Pre-adoption guard for the queued g8 chip A/B: shard-vs-single
+    under the phase-packed conv.
+
+    Measured behavior (this test found it): the contract is
+    parity-sensitive. A shard whose global output-row start is EVEN keeps
+    local phase parity == global parity and matches the single run
+    bitwise (n=1, 2, 4: conv1 row starts 0/28/14·k). An ODD start (n=3:
+    55 rows split 19/18/18, shard 1 starts at 19) flips the local parity,
+    which moves the zero-padding layout inside the phase weight frames —
+    same real products, different reduction grouping — so the middle
+    shard's rows drift by last-ulps (measured 2.3e-7 rel max). Values are
+    correct; bit-exactness would require even-aligning each shard's g8
+    row base (compute one extra garbage row and crop) — the named
+    adoption requirement if the chip A/B ever makes g8 the sharded-tier
+    default (docs/PALLAS_PERF.md).
+
+    The single-device side passes ``variants`` EXPLICITLY: a bare
+    ``jax.jit(forward_blocks12_pallas)`` after the fixture already traced
+    the default variant would hit the jit cache and silently compare g8
+    against vcol — the documented round-3 footgun the build-per-variant
+    workflow exists to avoid (first version of this test did exactly
+    that and produced a last-ulps false alarm)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "g8")
+    params, x, _, _ = workload
+    single = np.asarray(
+        forward_blocks12_pallas(params, x, variants=pk.KernelVariants(conv="g8"))
+    )
+    got = np.asarray(
+        build_forward(REGISTRY["v5_collective"], BLOCKS12, n_shards=n)(params, x)
+    )
+    if n == 3:  # odd-start shard: reduction-order tolerance, not bitwise
+        np.testing.assert_allclose(got, single, rtol=2e-6, atol=2e-6)
+        assert (got != single).any(), (
+            "n=3 now matches bitwise — the parity sensitivity is gone; "
+            "tighten this branch back to assert_array_equal"
+        )
+    else:
+        np.testing.assert_array_equal(got, single)
