@@ -131,3 +131,26 @@ def test_full_deterministic_cross_tier_exact():
     a = jax.jit(lambda p, x: forward_alexnet(p, x, SMALL))(params, x)
     b = jax.jit(lambda p, x: forward_alexnet_pallas(p, x, SMALL))(params, x)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_full_model_hpool_fusion_bitwise():
+    """fuse="hpool" on the FULL model (conv1/conv2/conv5 -> pool
+    adjacencies via the chain walker) is bitwise identical to unfused —
+    the blocks12 equality test can't see the conv5->pool5 adjacency or
+    the walker's skip-next bookkeeping. Variants passed explicitly (jit
+    cache footgun; see test_bit_exact's g8 probe)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_kernels as pk
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_model import (
+        forward_alexnet_pallas)
+
+    params = init_full_random(jax.random.PRNGKey(11), SMALL)
+    x = _x(2)
+    base = np.asarray(
+        forward_alexnet_pallas(params, x, SMALL, variants=pk.KernelVariants())
+    )
+    fused = np.asarray(
+        forward_alexnet_pallas(
+            params, x, SMALL, variants=pk.KernelVariants(fuse="hpool")
+        )
+    )
+    np.testing.assert_array_equal(base, fused)
