@@ -31,6 +31,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 BASELINE_IMG_PER_SEC = 1.0 / 0.183  # reference V4 best, RTX 3090 (BASELINE.md)
 METRIC = "alexnet_blocks12_images_per_sec"
@@ -76,7 +77,7 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, ROOT)
 
 
-def _error_json(msg: str, platform: str = "unknown") -> str:
+def _error_obj(msg: str, platform: str = "unknown") -> dict:
     out = {
         "metric": METRIC,
         "value": 0.0,
@@ -130,7 +131,13 @@ def _error_json(msg: str, platform: str = "unknown") -> str:
         # bench_latest.json must not erase the one JSON line the contract
         # guarantees.
         pass
-    return json.dumps(out)
+    return out
+
+
+def _error_json(msg: str, platform: str = "unknown") -> str:
+    """The historical one-JSON-line error contract (kept for consumers and
+    tests; the retry loop works on the dict form above)."""
+    return json.dumps(_error_obj(msg, platform))
 
 
 def _child() -> int:
@@ -248,15 +255,16 @@ def _child() -> int:
     return 0
 
 
-def main() -> int:
+def _measure_once() -> dict:
+    """One full probe+measure pass; returns the JSON object to emit (an
+    ``error`` field marks a failed/wedged pass the retry loop may re-run)."""
     here = os.path.dirname(os.path.abspath(__file__))
     # 1) Bounded device probe: a wedged tunnel hangs on the tiniest matmul.
     from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
 
     ok, info = probe(PROBE_TIMEOUT)
     if not ok:
-        print(_error_json(f"device {info}"))
-        return 0
+        return _error_obj(f"device {info}")
     platform = info
 
     # Auto-request a continuity row when the committed headline was captured
@@ -321,13 +329,58 @@ def main() -> int:
                 else f"rc={proc.returncode}"
             )
             salvaged["salvaged"] = f"child killed after primary row ({why})"
-        print(json.dumps(salvaged))
-        return 0
+        return salvaged
     if timed_out:
-        print(_error_json(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform))
-    else:
-        tail = ((stderr or stdout or "").strip().splitlines() or ["no output"])[-1:]
-        print(_error_json(f"benchmark failed (rc={proc.returncode}): {tail[0]}", platform))
+        return _error_obj(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform)
+    tail = ((stderr or stdout or "").strip().splitlines() or ["no output"])[-1:]
+    return _error_obj(f"benchmark failed (rc={proc.returncode}): {tail[0]}", platform)
+
+
+def main() -> int:
+    """Bounded wedge-aware re-capture around ``_measure_once``.
+
+    A pass that measured nothing (``error`` field, or a ``value`` of 0.0 —
+    the wedged-tunnel signature that silently destroyed four rounds of
+    headline evidence) is retried with backoff up to BENCH_MAX_RETRIES
+    (default 1) within BENCH_DEADLINE_S; the emitted JSON then carries
+    ``attempts`` / ``resilience`` metadata so retried rows are labeled.
+    Still always prints exactly ONE parseable JSON line and exits 0.
+    """
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
+        Deadline,
+        FaultLog,
+        RetryPolicy,
+    )
+
+    policy = RetryPolicy(
+        max_retries=int(os.environ.get("BENCH_MAX_RETRIES", "1")),
+        base_delay_s=float(os.environ.get("BENCH_RETRY_BACKOFF", "30")),
+        max_delay_s=300.0,
+    )
+    deadline = Deadline.after(float(os.environ.get("BENCH_DEADLINE_S", "0")) or None)
+    flog = FaultLog(site="bench")
+    out: dict = {}
+    for attempt in range(max(0, policy.max_retries) + 1):
+        t0 = time.monotonic()
+        out = _measure_once()
+        value = out.get("value")
+        wedged = bool(out.get("error")) or not (
+            isinstance(value, (int, float)) and value > 0
+        )
+        if not wedged:
+            flog.record("ok", duration_s=time.monotonic() - t0)
+            break
+        cause = str(out.get("error") or f"value={value!r} (wedged capture)")[:160]
+        if attempt >= policy.max_retries or deadline.expired:
+            flog.record("fail", cause, time.monotonic() - t0)
+            break
+        pause = min(policy.delay_s(attempt + 1), deadline.remaining())
+        flog.record("retry", cause, time.monotonic() - t0, backoff_s=pause)
+        time.sleep(pause)
+    out["attempts"] = flog.n_attempts
+    if flog.retried:
+        out["resilience"] = flog.summary()
+    print(json.dumps(out))
     return 0
 
 

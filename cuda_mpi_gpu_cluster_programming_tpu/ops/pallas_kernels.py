@@ -162,6 +162,27 @@ def _k_block() -> int:
     return int(env_variant("TPU_FRAMEWORK_KBLOCK", "0", ("0", "64", "128")))
 
 
+# One warning per (k_block, K) per process: trace-time, so an unbounded
+# per-call stream would drown the A/B log it is trying to protect.
+_K_BLOCK_WARNED: set = set()
+
+
+def _warn_k_block_dropped(k_block: int, kk: int) -> None:
+    key = (k_block, kk)
+    if key in _K_BLOCK_WARNED:
+        return
+    _K_BLOCK_WARNED.add(key)
+    import warnings
+
+    warnings.warn(
+        f"requested k_block={k_block} does not apply to K={kk} (needs "
+        f"K % k_block == 0 and K > k_block) — this conv runs UNBLOCKED; "
+        "label its A/B rows kb=0",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 # Epilogue fusion (round-5 lever): "hpool" fuses the separable pool's H
 # stage into the conv epilogue where the model's conv feeds a pool (the
 # full-height conv output never round-trips HBM; the pool's first kernel
@@ -714,10 +735,24 @@ def _conv2d_pallas(
         # blocked operand's minor dim is k_block, and the lane tiling is 128
         # — a non-multiple (the env's 64 setting) cannot lower on chip
         # ("block shape is a multiple of the tiling size"). Interpret mode
-        # has no tiling, so CI keeps exercising 64; on hardware the lever
-        # is silently off, same policy as K % k_block != 0.
+        # has no tiling, so CI keeps exercising 64; on hardware the request
+        # is REFUSED rather than silently dropped (same raise-not-fallback
+        # policy as hpool): an A/B row labeled kb=64 measuring kb=0 is
+        # mislabeled perf evidence (ADVICE round-5 item 1).
         k_block_ok = k_block % 128 == 0 or _interpret()
-        if k_block and kk % k_block == 0 and kk > k_block and k_block_ok:
+        if k_block and not k_block_ok:
+            raise ValueError(
+                f"k_block={k_block} cannot lower on {jax.default_backend()}: "
+                "the lane tiling is 128, so k_block must be a multiple of 128 "
+                "on hardware (interpret mode has no tiling); unset "
+                "TPU_FRAMEWORK_KBLOCK or use 128"
+            )
+        if k_block and not (kk % k_block == 0 and kk > k_block):
+            # Geometry fallback (e.g. conv1's K=96 under kb=128): legitimate
+            # per-layer degradation, but it must be VISIBLE — a one-time
+            # warning per (k_block, K) so A/B logs can label this layer kb=0.
+            _warn_k_block_dropped(k_block, kk)
+        if k_block and kk % k_block == 0 and kk > k_block:
             # Third grid dim over K blocks (the round-4 verdict's named
             # next lever): each program owns k_block output channels, so
             # the VMEM-resident weight slice and fp32 accumulator shrink

@@ -39,9 +39,80 @@ import time
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..resilience import chaos
+from ..resilience.policy import Deadline, DegradedEvent, FaultLog, RetryPolicy
 from .distributed import ClusterConfig, HostSpec, launch_plan
 
 _SYNC_EXCLUDES = (".git", "__pycache__", ".warehouse", "logs", ".pytest_cache", "*.so")
+
+# Transport default: 2 bounded retries with 1 s/2 s backoff — enough to ride
+# out the ssh/rsync transients the tunnel actually produces without turning
+# a dead host into a multi-minute stall.
+TRANSPORT_POLICY = RetryPolicy(max_retries=2, base_delay_s=1.0, max_delay_s=15.0)
+
+
+def _transport_run(
+    argv,
+    *,
+    site: str,
+    timeout_s: float,
+    policy: Optional[RetryPolicy] = None,
+    deadline: Optional[Deadline] = None,
+    shell: bool = False,
+    sleep=time.sleep,
+    **kw,
+) -> Tuple[Optional[subprocess.CompletedProcess], FaultLog]:
+    """Every ssh/rsync execution routes through here: bounded retry with
+    backoff, deadline propagation (per-attempt timeout never outlives the
+    budget), a per-attempt FaultLog, and the chaos injection point for the
+    ``ssh``/``rsync`` sites.
+
+    Returns ``(proc, fault_log)`` where ``proc`` is the LAST attempt (or
+    None if it raised). A FileNotFoundError (no ssh/rsync binary) is
+    permanent and re-raised immediately; a TimeoutExpired on the final
+    attempt is re-raised so call sites keep their historical handling."""
+    policy = policy or TRANSPORT_POLICY
+    deadline = deadline or Deadline.after(None)
+    flog = FaultLog(site=site)
+    for attempt in range(max(0, policy.max_retries) + 1):
+        t0 = time.monotonic()
+        exc: Optional[BaseException] = None
+        proc: Optional[subprocess.CompletedProcess] = None
+        ch = chaos.active()
+        if ch and ch.draw(site):
+            proc = subprocess.CompletedProcess(
+                argv, 255, stdout="", stderr=f"chaos: injected {site} transient"
+            )
+        else:
+            try:
+                # The retrying transport's own bounded execution.
+                proc = subprocess.run(  # noqa: raw-subprocess
+                    argv,
+                    shell=shell,
+                    timeout=deadline.remaining(cap=timeout_s),
+                    **kw,
+                )
+            except FileNotFoundError:
+                raise  # no transport binary: permanent, never retryable
+            except (subprocess.TimeoutExpired, OSError) as e:
+                exc = e
+        if proc is not None and proc.returncode == 0:
+            flog.record("ok", duration_s=time.monotonic() - t0)
+            return proc, flog
+        cause = (
+            f"{type(exc).__name__}" if exc is not None
+            else f"exit {proc.returncode}: {str(proc.stderr or '').strip()[:120]}"
+        )
+        if attempt >= policy.max_retries or deadline.expired:
+            flog.record("fail", cause, time.monotonic() - t0)
+            if exc is not None:
+                raise exc
+            return proc, flog
+        pause = min(policy.delay_s(attempt + 1), deadline.remaining())
+        flog.record("retry", cause, time.monotonic() - t0, backoff_s=pause)
+        if pause > 0:
+            sleep(pause)
+    raise AssertionError("unreachable")  # pragma: no cover
 
 # Result-line contract of the per-host workloads (selftest/examples print
 # "... -> PASSED|FAILED"; the run CLI prints the timing contract lines).
@@ -108,9 +179,15 @@ class HostResult:
 
 
 def check_reachable(
-    cluster: ClusterConfig, timeout_s: float = 10.0, dry_run: bool = False
+    cluster: ClusterConfig,
+    timeout_s: float = 10.0,
+    dry_run: bool = False,
+    policy: Optional[RetryPolicy] = None,
+    deadline: Optional[Deadline] = None,
 ) -> List[Tuple[str, bool, str]]:
-    """SSH reachability sweep before deploying (:229-238 analogue)."""
+    """SSH reachability sweep before deploying (:229-238 analogue), with
+    bounded per-host retry: a transient ssh exit must not cost a host its
+    slot in the deployment."""
     out = []
     for h in cluster.hosts:
         if is_local(h):
@@ -121,8 +198,15 @@ def check_reachable(
             out.append((h.host, True, "DRY: " + " ".join(cmd)))
             continue
         try:
-            rc = subprocess.run(cmd, capture_output=True, timeout=timeout_s + 5).returncode
-            out.append((h.host, rc == 0, "ok" if rc == 0 else f"ssh exit {rc}"))
+            proc, flog = _transport_run(
+                cmd, site="ssh", timeout_s=timeout_s + 5,
+                policy=policy, deadline=deadline, capture_output=True,
+            )
+            ok = proc is not None and proc.returncode == 0
+            msg = "ok" if ok else f"ssh exit {proc.returncode}"
+            if ok and flog.retried:
+                msg = f"ok after {flog.n_attempts} attempts"
+            out.append((h.host, ok, msg))
         except (subprocess.TimeoutExpired, FileNotFoundError) as e:
             out.append((h.host, False, type(e).__name__))
     return out
@@ -133,12 +217,20 @@ def sync_code(
     src: str,
     workdir: str,
     dry_run: bool = False,
+    policy: Optional[RetryPolicy] = None,
+    deadline: Optional[Deadline] = None,
+    on_error: str = "raise",
 ) -> List[Tuple[str, str]]:
     """Push the code tree to every host's workdir (:258-287 analogue).
 
-    Remote hosts get ``rsync -az --delete``; local hosts a copytree (skipped
-    entirely when src == workdir, the run-in-place case). Returns
-    (host, action) pairs."""
+    Remote hosts get ``rsync -az --delete`` through the retrying transport;
+    local hosts a copytree (skipped entirely when src == workdir, the
+    run-in-place case). Returns (host, action) pairs. ``on_error="report"``
+    records a terminally failed host as ``"SYNC_FAILED: ..."`` instead of
+    raising — the quorum-degradation path in ``deploy_and_collect`` drops
+    such hosts and keeps the rest of the cluster."""
+    if on_error not in ("raise", "report"):
+        raise ValueError(f"on_error must be raise|report, got {on_error!r}")
     src = str(Path(src).resolve())
     actions = []
     for h in cluster.hosts:
@@ -159,10 +251,25 @@ def sync_code(
             if dry_run:
                 actions.append((h.host, "DRY: " + cmd))
                 continue
-            proc = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+            try:
+                proc, flog = _transport_run(
+                    cmd, site="rsync", timeout_s=600.0, policy=policy,
+                    deadline=deadline, shell=True, capture_output=True, text=True,
+                )
+            except (subprocess.TimeoutExpired, FileNotFoundError) as e:
+                if on_error == "report":
+                    actions.append((h.host, f"SYNC_FAILED: {type(e).__name__}"))
+                    continue
+                raise RuntimeError(f"rsync to {h.host} failed: {type(e).__name__}") from e
             if proc.returncode != 0:
-                raise RuntimeError(f"rsync to {h.host} failed: {proc.stderr.strip()[:200]}")
-            actions.append((h.host, "rsync ok"))
+                detail = str(proc.stderr or "").strip()[:200]
+                if on_error == "report":
+                    actions.append((h.host, f"SYNC_FAILED: {detail}"))
+                    continue
+                raise RuntimeError(f"rsync to {h.host} failed: {detail}")
+            actions.append(
+                (h.host, "rsync ok" + (f" after {flog.n_attempts} attempts" if flog.retried else ""))
+            )
     return actions
 
 
@@ -184,18 +291,30 @@ def deploy_and_collect(
     sync_from: Optional[str] = None,
     dry_run: bool = False,
     session_tag: str = "",
+    quorum: float = 1.0,
+    transport_policy: Optional[RetryPolicy] = None,
+    deadline: Optional[Deadline] = None,
+    lost_hosts: Sequence[Tuple[str, str]] = (),
 ) -> List[HostResult]:
     """The whole pipeline: (validate ->) sync -> launch all hosts
     concurrently -> wait -> capture per-host logs -> parse -> summary CSV.
 
     One command launches the inventory and returns the parsed per-host
     results — the capability of :393-410/:502-548 in one call.
+
+    ``quorum`` < 1.0 enables partial-cluster graceful degradation: a host
+    whose code sync terminally fails is DROPPED (reported as an UNREACHABLE
+    row) and the launch plan re-renders for the surviving mesh, provided at
+    least ``quorum`` of the inventory survives; the default 1.0 keeps the
+    historical any-failure-raises behavior. ``lost_hosts`` carries hosts a
+    caller already dropped (e.g. the CLI's reachability quorum) so they land
+    in the same summary CSV instead of vanishing.
     """
     session = f"deploy_{session_tag or time.strftime('%Y%m%d_%H%M%S')}"
     session_dir = Path(log_root) / session
-    cmds = launch_plan(cluster, script, script_args, workdir=workdir, extra_env=extra_env)
 
     if dry_run:
+        cmds = launch_plan(cluster, script, script_args, workdir=workdir, extra_env=extra_env)
         if sync_from:
             for host, action in sync_code(cluster, sync_from, workdir, dry_run=True):
                 print(f"sync {host}: {action}")
@@ -206,10 +325,41 @@ def deploy_and_collect(
             for i, h in enumerate(cluster.hosts)
         ]
 
+    lost: List[HostResult] = [
+        HostResult(host=host, process_id=-1, status=UNREACHABLE, tail=reason)
+        for host, reason in lost_hosts
+    ]
     if sync_from:
-        for host, action in sync_code(cluster, sync_from, workdir):
+        actions = sync_code(
+            cluster, sync_from, workdir, policy=transport_policy,
+            deadline=deadline, on_error="report" if quorum < 1.0 else "raise",
+        )
+        for host, action in actions:
             print(f"sync {host}: {action}")
+        failed = {host for host, action in actions if action.startswith("SYNC_FAILED")}
+        if failed:
+            alive = tuple(h for h in cluster.hosts if h.host not in failed)
+            total = len(cluster.hosts) + len(lost)
+            if not alive or len(alive) / total < quorum:
+                raise RuntimeError(
+                    f"quorum lost: {len(alive)}/{total} hosts alive after sync "
+                    f"failures on {sorted(failed)} (quorum {quorum:.2f})"
+                )
+            print(DegradedEvent(
+                f"cluster n={len(cluster.hosts)}", f"n={len(alive)}",
+                "code sync failed on " + ", ".join(sorted(failed)),
+            ))
+            lost += [
+                HostResult(host=h.host, process_id=-1, status=UNREACHABLE,
+                           tail="code sync failed")
+                for h in cluster.hosts if h.host in failed
+            ]
+            # Mesh shrink: the launch plan re-renders below with the new
+            # process ids/count; a lost coordinator slot just promotes the
+            # next host (host 0 of the shrunk inventory).
+            cluster = dataclasses.replace(cluster, hosts=alive)
 
+    cmds = launch_plan(cluster, script, script_args, workdir=workdir, extra_env=extra_env)
     session_dir.mkdir(parents=True, exist_ok=True)
     # 5-tuples: the open log handle rides along so it stays open until after
     # wait() (the child writes through it) and is closed before the parse.
@@ -233,8 +383,9 @@ def deploy_and_collect(
         f.flush()
         try:
             # New session so a timeout can kill the whole process group
-            # (bash/ssh wrapper AND the python worker beneath it).
-            p = subprocess.Popen(
+            # (bash/ssh wrapper AND the python worker beneath it). Not a
+            # transport: the workload launch itself, deadline-killed below.
+            p = subprocess.Popen(  # noqa: raw-subprocess
                 argv, stdout=f, stderr=subprocess.STDOUT, text=True,
                 start_new_session=True,
             )
@@ -280,7 +431,9 @@ def deploy_and_collect(
                 # targets, and narrower than leaking the orphan.
                 pat = f"-m {re.escape(script)}( |$)"
                 try:
-                    subprocess.run(
+                    # Best-effort one-shot teardown, bounded at 15 s: a
+                    # retry here would stall every remaining host's collect.
+                    subprocess.run(  # noqa: raw-subprocess
                         ["ssh", "-o", "BatchMode=yes", h.ssh_target,
                          f"pkill -f -- {shlex.quote(pat)}"],
                         capture_output=True,
@@ -308,6 +461,9 @@ def deploy_and_collect(
             )
         )
 
+    # Lost hosts (reachability/sync quorum drops) are REPORTED, not erased:
+    # they ride the same results list and summary CSV as UNREACHABLE rows.
+    results += lost
     # Summary schema follows the harness/analysis contract (Variant + Status
     # columns) so analysis._csv_kind recognizes it and deploy sessions land
     # in the warehouse like any other session; Host/ProcessID/Verdict are
@@ -342,6 +498,27 @@ def main(argv=None) -> int:
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("--skip-reachability", action="store_true")
     p.add_argument("--port", type=int, default=0, help="coordinator port (0 = pick a free one)")
+    p.add_argument(
+        "--max-retries",
+        type=int,
+        default=TRANSPORT_POLICY.max_retries,
+        help="bounded retries per ssh/rsync transport call",
+    )
+    p.add_argument(
+        "--quorum",
+        type=float,
+        default=1.0,
+        help="minimum fraction of the inventory that must be reachable/"
+        "synced to proceed on a shrunk cluster (1.0 = historical all-or-"
+        "abort); lost hosts are reported as UNREACHABLE rows",
+    )
+    p.add_argument(
+        "--deadline-s",
+        type=float,
+        default=0.0,
+        help="wall-clock budget for the transport phase (reach+sync retries "
+        "never outlive it; 0 = unbounded)",
+    )
     args = p.parse_args(argv)
 
     port = args.port
@@ -349,13 +526,32 @@ def main(argv=None) -> int:
         with socket.socket() as s:
             s.bind(("localhost", 0))
             port = s.getsockname()[1]
+    if not 0.0 < args.quorum <= 1.0:
+        print(f"--quorum must be in (0, 1], got {args.quorum}")
+        return 2
+    policy = RetryPolicy(max_retries=max(0, args.max_retries), base_delay_s=1.0, max_delay_s=15.0)
+    deadline = Deadline.after(args.deadline_s or None)
     cluster = ClusterConfig.parse(args.hosts, port=port)
+    lost: List[Tuple[str, str]] = []
     if not args.skip_reachability:
-        checks = check_reachable(cluster, dry_run=args.dry_run)
+        checks = check_reachable(
+            cluster, dry_run=args.dry_run, policy=policy, deadline=deadline
+        )
         for host, ok, msg in checks:
             print(f"reach {host}: {'ok' if ok else 'FAILED'} ({msg})")
-        if not all(ok for _, ok, _ in checks):
-            return 2
+        dead = [(host, msg) for host, ok, msg in checks if not ok]
+        if dead:
+            alive_frac = (len(checks) - len(dead)) / len(checks)
+            if args.quorum >= 1.0 or alive_frac < args.quorum:
+                return 2
+            dead_names = {h for h, _ in dead}
+            alive = tuple(h for h in cluster.hosts if h.host not in dead_names)
+            print(DegradedEvent(
+                f"cluster n={len(cluster.hosts)}", f"n={len(alive)}",
+                "unreachable: " + ", ".join(sorted(dead_names)),
+            ))
+            cluster = dataclasses.replace(cluster, hosts=alive)
+            lost = [(h, f"unreachable: {m}") for h, m in dead]
 
     extra_env = None
     if args.fake_devices:
@@ -374,6 +570,10 @@ def main(argv=None) -> int:
         extra_env=extra_env,
         sync_from=args.sync_from,
         dry_run=args.dry_run,
+        quorum=args.quorum,
+        transport_policy=policy,
+        deadline=deadline,
+        lost_hosts=lost,
     )
     for r in results:
         t = f" {r.time_ms:.1f} ms" if r.time_ms is not None else ""
@@ -381,7 +581,10 @@ def main(argv=None) -> int:
         print(f"host{r.process_id} {r.host}: {r.status}{t}{v}  ({r.log_file})")
     if args.dry_run:
         return 0
-    return 0 if all(r.status == OK for r in results) else 1
+    # Quorum-dropped hosts (process_id < 0) degrade the deploy, they don't
+    # fail it — the surviving mesh's own outcomes decide the exit code.
+    launched = [r for r in results if r.process_id >= 0]
+    return 0 if launched and all(r.status == OK for r in launched) else 1
 
 
 if __name__ == "__main__":

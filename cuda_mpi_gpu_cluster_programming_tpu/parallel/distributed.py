@@ -217,7 +217,9 @@ def launch_local(
         env["JAX_NUM_PROCESSES"] = str(n_processes)
         env["JAX_PROCESS_ID"] = str(pid)
         procs.append(
-            subprocess.Popen(
+            # The local cluster launch itself (waited on with a bounded
+            # timeout by the caller), not a retryable transport.
+            subprocess.Popen(  # noqa: raw-subprocess
                 [sys.executable, "-m", module, *module_args],
                 env=env,
                 stdout=subprocess.PIPE,

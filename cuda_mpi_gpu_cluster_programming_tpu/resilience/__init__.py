@@ -1,0 +1,56 @@
+"""Resilience subsystem: retry/backoff/deadline policy, graceful tier
+degradation, and deterministic fault injection.
+
+The reference treats every failure as terminal (its V4 ships with known
+bugs, V5 is a 0-byte stub) and four rounds of evidence capture here were
+eaten by a wedged TPU tunnel recording ``value=0.0`` rows. This package is
+the production-stack answer (in the spirit of Varuna's preemption-tolerant
+scheduling and CheckFreq-style recovery):
+
+- ``policy``  — ``RetryPolicy`` (exponential backoff + deterministic
+  jitter), ``Deadline`` propagation, per-attempt ``FaultLog`` records, the
+  ``retry_call`` combinator, and the ``Degrader`` that walks an ordered
+  fallback chain emitting structured ``DEGRADED(from, to, cause)`` events
+  instead of crashing.
+- ``chaos``   — seed-driven fault injectors (collective failure, device
+  loss, kernel-compile failure, subprocess wedge, ssh/rsync transients)
+  enabled via the ``CHAOS_SPEC`` environment variable so every recovery
+  path is exercisable on CPU in tier-1 tests.
+
+Wired through ``harness`` (DEGRADED triage + wedge-aware re-capture),
+``parallel.deploy`` (retrying transports + quorum degradation), ``run``
+(``--max-retries/--fallback-chain/--deadline-s``) and the bench capture
+scripts. See docs/RESILIENCE.md.
+"""
+
+from .chaos import CHAOS_ENV, ChaosInjector, ChaosSpec, InjectedFault, active
+from .policy import (
+    DEGRADED,
+    Attempt,
+    Deadline,
+    DegradationExhausted,
+    DegradedEvent,
+    Degrader,
+    FaultLog,
+    RetryPolicy,
+    retry_call,
+    tier_fallback_chain,
+)
+
+__all__ = [
+    "CHAOS_ENV",
+    "ChaosInjector",
+    "ChaosSpec",
+    "InjectedFault",
+    "active",
+    "DEGRADED",
+    "Attempt",
+    "Deadline",
+    "DegradationExhausted",
+    "DegradedEvent",
+    "Degrader",
+    "FaultLog",
+    "RetryPolicy",
+    "retry_call",
+    "tier_fallback_chain",
+]

@@ -1,0 +1,140 @@
+"""Deterministic, seed-driven fault injection (chaos engineering lite).
+
+Enabled via the ``CHAOS_SPEC`` environment variable so every recovery path
+in the resilience subsystem is exercisable on CPU, in-process, in tier-1
+tests — no wedged tunnel required. The spec is a comma-separated list:
+
+    CHAOS_SPEC="seed=7,ssh=2,subprocess_wedge=1,collective=p0.5"
+
+- ``seed=N``     — RNG seed for probabilistic sites (default 0).
+- ``<site>=N``   — fail the first N draws at that site, then heal
+                   (the transient-fault model: retry/degrade paths must
+                   recover exactly at draw N+1).
+- ``<site>=pX``  — each draw at that site fails with probability X from a
+                   per-site stream seeded by (seed, site): deterministic
+                   for a given spec, order-independent across sites.
+
+Known sites (consumers listed; unknown sites parse fine and simply never
+fire, so specs can outlive code):
+
+    collective        run CLI build step (sharded strategies) — transient
+                      collective/ICI failure.
+    device_loss       run CLI build step — mesh shrink (needs N, have M).
+    kernel_compile    run CLI build step (pallas tier) — Mosaic lowering
+                      failure; degrades Pallas -> XLA reference tier.
+    subprocess_wedge  harness.run_case — the classic wedged-tunnel capture
+                      (run "succeeds" with value=0.0 output).
+    ssh               parallel.deploy transports — transient ssh exit.
+    rsync             parallel.deploy transports — transient rsync exit.
+
+Counters are per-process; CHAOS_SPEC rides the environment into harness/
+deploy children, where each child gets its own deterministic stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+from typing import Dict, Optional
+
+CHAOS_ENV = "CHAOS_SPEC"
+
+
+class InjectedFault(RuntimeError):
+    """A fault manufactured by the chaos layer — never raised by real code,
+    so recovery paths can tell drills from genuine failures in logs."""
+
+    def __init__(self, site: str, detail: str = ""):
+        super().__init__(f"chaos: injected {site} fault" + (f" ({detail})" if detail else ""))
+        self.site = site
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """Parsed CHAOS_SPEC: count-based and probabilistic sites."""
+
+    seed: int = 0
+    counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    probs: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosSpec":
+        seed, counts, probs = 0, {}, {}
+        for item in (text or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(f"malformed CHAOS_SPEC item {item!r} (want site=N|pX)")
+            site, _, val = item.partition("=")
+            site, val = site.strip(), val.strip()
+            if site == "seed":
+                seed = int(val)
+            elif val.startswith("p"):
+                p = float(val[1:])
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"CHAOS_SPEC {site}={val}: probability outside [0,1]")
+                probs[site] = p
+            else:
+                counts[site] = int(val)
+        return cls(seed=seed, counts=counts, probs=probs)
+
+    @property
+    def empty(self) -> bool:
+        return not self.counts and not self.probs
+
+
+class ChaosInjector:
+    """Stateful per-process injector over a ChaosSpec."""
+
+    def __init__(self, spec: ChaosSpec):
+        self.spec = spec
+        self._remaining = dict(spec.counts)
+        self._rng = {
+            site: random.Random(f"{spec.seed}:{site}") for site in spec.probs
+        }
+        self.fired: Dict[str, int] = {}
+
+    def draw(self, site: str) -> bool:
+        """True = inject a fault at this site now. Count-based sites burn
+        down; probabilistic sites draw from their seeded stream."""
+        hit = False
+        if self._remaining.get(site, 0) > 0:
+            self._remaining[site] -= 1
+            hit = True
+        elif site in self._rng:
+            hit = self._rng[site].random() < self.spec.probs[site]
+        if hit:
+            self.fired[site] = self.fired.get(site, 0) + 1
+        return hit
+
+    def maybe_raise(self, site: str, detail: str = "") -> None:
+        if self.draw(site):
+            raise InjectedFault(site, detail)
+
+
+# Process-wide injector, cached per CHAOS_SPEC value so counters persist
+# across call sites within one process but a test's monkeypatched env takes
+# effect immediately.
+_cached: Optional[tuple] = None  # (spec_text, injector)
+
+
+def active() -> Optional[ChaosInjector]:
+    """The process injector, or None when CHAOS_SPEC is unset/empty —
+    callers guard with ``ch = active();  if ch and ch.draw(...)`` so the
+    chaos-off hot path costs one env read."""
+    global _cached
+    text = os.environ.get(CHAOS_ENV, "")
+    if not text.strip():
+        _cached = None
+        return None
+    if _cached is None or _cached[0] != text:
+        _cached = (text, ChaosInjector(ChaosSpec.parse(text)))
+    return _cached[1]
+
+
+def reset() -> None:
+    """Forget the cached injector (tests: fresh counters per case)."""
+    global _cached
+    _cached = None

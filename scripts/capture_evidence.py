@@ -43,7 +43,10 @@ def run(name: str, cmd, timeout_s: float, statuses: dict) -> subprocess.Complete
     print(f"\n=== {name}: {' '.join(map(str, cmd))}")
     t0 = time.perf_counter()
     try:
-        proc = subprocess.run(
+        # The capture runner IS the bounded wrapper (timeout + status
+        # tracking); step-level retry lives in the steps themselves
+        # (bench.py re-captures wedges internally).
+        proc = subprocess.run(  # noqa: raw-subprocess
             [str(c) for c in cmd], cwd=ROOT, timeout=timeout_s, text=True,
             capture_output=True,
         )
@@ -127,8 +130,11 @@ def main() -> int:
         ]
         statuses["harness"] = bad[0] if bad else "OK"
 
-    # 3. Headline bench (JSON line with MFU).
-    bench = run("bench", [py, "bench.py"], 1200, statuses)
+    # 3. Headline bench (JSON line with MFU). 2600 s: bench.py now re-
+    #    captures a wedged pass internally (BENCH_MAX_RETRIES, default 1),
+    #    so the outer bound must cover two probe+measure passes + backoff —
+    #    a shorter cap would kill the retry that exists to save the row.
+    bench = run("bench", [py, "bench.py"], 2600, statuses)
     if bench:
         line = next(
             (l for l in reversed(bench.stdout.splitlines()) if l.startswith("{")), None
@@ -138,14 +144,25 @@ def main() -> int:
         else:
             print("BENCH:", line)
             # bench.py exits 0 even on a wedge (its error is IN the JSON) —
-            # a dead benchmark must not count as a captured one.
+            # a dead benchmark must not count as a captured one. Persisting
+            # is gated on a POSITIVE measured value, not just the absence of
+            # an error field: a value<=0 row is the wedged-capture signature
+            # that silently destroyed four rounds of headline evidence and
+            # must never become bench_latest.json.
             try:
                 parsed = json.loads(line)
             except json.JSONDecodeError:
                 parsed = {"error": "unparseable JSON"}
+            value = parsed.get("value")
             if parsed.get("error"):
                 statuses["bench"] = f"error: {str(parsed['error'])[:70]}"
+            elif not (isinstance(value, (int, float)) and value > 0):
+                statuses["bench"] = f"refused wedged row (value={value!r})"
             else:
+                if parsed.get("attempts", 1) > 1:
+                    # Retried rows stay labeled all the way into the status
+                    # table — a healed-on-retry headline is still a flag.
+                    statuses["bench"] = f"OK ({parsed['attempts']} attempts)"
                 Path(ROOT / "perf").mkdir(exist_ok=True)
                 (ROOT / "perf" / "bench_latest.json").write_text(line + "\n")
 
@@ -222,7 +239,8 @@ def main() -> int:
     for k, v in statuses.items():
         print(f"  {k:28s} {v}")
     essential = ["probe", "harness", "bench", "ingest_ours", "report", "plots"]
-    ok = all(statuses.get(k) == "OK" for k in essential)
+    # "OK (N attempts)" — a retried-but-healed step — still satisfies the gate.
+    ok = all(str(statuses.get(k, "")).startswith("OK") for k in essential)
     if ok:
         print("\nAll essential steps OK. Commit: logs/<session>/, perf/, plots/, analysis_exports/")
     return 0 if ok else 1

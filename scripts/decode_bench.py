@@ -59,8 +59,18 @@ def bench_cell(params, cfg, batch: int, plen: int, steps: int, repeats: int):
         jax.block_until_ready(run(params, prompt))
         samples.append(time.perf_counter() - t0)
     wall = statistics.median(samples)
+    # The decode scan replays the prompt teacher-forced, so `wall` covers
+    # plen + steps scan iterations of identical per-step cost. tok_s keeps
+    # its historical definition (generated tokens over TOTAL wall — the
+    # amortized-prefill serving number) but cross-run comparisons at
+    # different --prompt values skew, so the prefill share is estimated
+    # (wall * plen/(plen+steps)) and subtracted into tok_s_decode_only —
+    # the prompt-length-independent decode rate (ADVICE round-5 item 4).
+    prefill_est = wall * plen / (plen + steps)
     return {
         "tok_s": round(batch * steps / wall, 1),
+        "tok_s_decode_only": round(batch * steps / (wall - prefill_est), 1),
+        "prefill_est_ms": round(prefill_est * 1e3, 2),
         "ms_per_step": round(wall / steps * 1e3, 4),
         "wall_ms": round(wall * 1e3, 2),
         "timing_n": repeats,

@@ -12,6 +12,14 @@ bitten this codebase plus the usual hygiene set:
   mutable-default — list/dict/set literals as parameter defaults.
   deprecated    — banned API census (see DEPRECATED below), the tidy
                   checks list; grown as CI surfaces new deprecations.
+  raw-subprocess — bare ``subprocess.run/Popen/call/check_*`` in
+                  ``parallel/`` or ``scripts/``: transport/step execution
+                  there must route through the resilience layer
+                  (``parallel.deploy._transport_run`` or an equivalently
+                  bounded+retried wrapper) so code can't regress to the
+                  fail-open one-shot execution that ate four rounds of
+                  bench evidence. A deliberate bounded call site is
+                  annotated ``# noqa: raw-subprocess``.
   tabs / trailing-ws / long-lines(>120) — formatting conventions.
 
 Run: ``python scripts/lint.py [paths...]`` — exit 0 clean, 1 findings.
@@ -40,6 +48,16 @@ DEPRECATED = [
 
 Finding = Tuple[Path, int, str, str]  # file, line, code, message
 
+# Directories where one-shot subprocess execution is a resilience regression
+# (the deploy transports and the evidence-capture scripts); the members
+# checked are the execution entry points, not the module itself.
+_RAW_SUBPROCESS_DIRS = ("parallel", "scripts")
+_SUBPROCESS_CALLS = {"run", "Popen", "call", "check_call", "check_output"}
+
+
+def _raw_subprocess_scoped(path: Path) -> bool:
+    return any(part in _RAW_SUBPROCESS_DIRS for part in path.parts)
+
 
 def _noqa_lines(src: str) -> dict:
     """line -> set of suppressed codes ('*' = all)."""
@@ -61,6 +79,7 @@ class _Checker(ast.NodeVisitor):
         self.imported: dict = {}  # name -> lineno
         self.used: set = set()
         self.src = src
+        self.check_raw_subprocess = _raw_subprocess_scoped(path)
 
     # --- imports ---
     def visit_Import(self, node: ast.Import) -> None:
@@ -87,6 +106,24 @@ class _Checker(ast.NodeVisitor):
             root = root.value
         if isinstance(root, ast.Name):
             self.used.add(root.id)
+        self.generic_visit(node)
+
+    # --- raw subprocess execution (parallel//scripts/ only) ---
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            self.check_raw_subprocess
+            and isinstance(f, ast.Attribute)
+            and f.attr in _SUBPROCESS_CALLS
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "subprocess"
+        ):
+            self.findings.append(
+                (self.path, node.lineno, "raw-subprocess",
+                 f"bare subprocess.{f.attr}() bypasses the retrying transport "
+                 "(use parallel.deploy._transport_run or a bounded wrapper; "
+                 "annotate deliberate call sites with # noqa: raw-subprocess)")
+            )
         self.generic_visit(node)
 
     # --- bare except ---

@@ -93,7 +93,10 @@ else
     # state). bench.py prints the JSON line; persist it the way
     # capture_evidence does, but only if it measured something (a flapping
     # tunnel mid-run must not erase the committed headline with value 0).
-    BENCH_LINE=$(timeout 1200 python bench.py 2>>"$LOG" | tail -1)
+    # BENCH_MAX_RETRIES=0: bench.py's internal wedge re-capture (default 1
+    # retry + backoff) could outlive this bounded heal-window slot; a
+    # flapping tunnel here keeps the committed headline (the else branch).
+    BENCH_LINE=$(BENCH_MAX_RETRIES=${BENCH_MAX_RETRIES:-0} timeout 1200 python bench.py 2>>"$LOG" | tail -1)
     echo "$BENCH_LINE" | tee -a "$LOG"
     if echo "$BENCH_LINE" | python -c "import json,sys; d=json.loads(sys.stdin.read()); sys.exit(0 if d.get('value',0)>0 else 1)" 2>/dev/null; then
         echo "$BENCH_LINE" > perf/bench_latest.json
@@ -123,12 +126,19 @@ print("g8 lowering+correctness OK on", jax.devices()[0].platform)
 EOF
 then
     echo "g8 on-chip correctness OK" | tee -a "$LOG"
+    # Row prefixes come from the RESOLVED KernelVariants, not hardcoded
+    # strings (ADVICE round-5 item 3): if the env or code defaults drift,
+    # the combo rows conv_ab_report parses must say what actually ran.
+    G8_PREFIX=$(TPU_FRAMEWORK_CONV=g8 python -c "
+from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import KernelVariants
+v = KernelVariants.resolve()
+print(f'conv={v.conv} rb={v.row_block} kb={v.k_block}')")
     for comp in bf16 fp32; do
         TPU_FRAMEWORK_CONV=g8 timeout 600 \
             python -m cuda_mpi_gpu_cluster_programming_tpu.run \
             --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
             | grep "completed in" \
-            | sed "s/^/conv=g8 rb=64 kb=0 $comp /" | tee -a "$LOG"
+            | sed "s/^/$G8_PREFIX $comp /" | tee -a "$LOG"
     done
 else
     say "g8 FAILED to lower or mismatched on chip — see $LOG; A/B skipped (vcol default stands)"
@@ -159,11 +169,16 @@ then
     echo "hpool on-chip bitwise OK" | tee -a "$LOG"
     for comp in bf16 fp32; do
         for fuse in none hpool; do
+            # Resolved-variant prefix, same policy as the g8 A/B above.
+            FUSE_PREFIX=$(TPU_FRAMEWORK_FUSE=$fuse python -c "
+from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import KernelVariants
+v = KernelVariants.resolve()
+print(f'fuse={v.fuse} conv={v.conv} rb={v.row_block} kb={v.k_block}')")
             TPU_FRAMEWORK_FUSE=$fuse timeout 600 \
                 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
                 --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
                 | grep "completed in" \
-                | sed "s/^/fuse=$fuse conv=vcol rb=64 kb=0 $comp /" | tee -a "$LOG"
+                | sed "s/^/$FUSE_PREFIX $comp /" | tee -a "$LOG"
         done
     done
 else
@@ -203,11 +218,12 @@ done
 say "serving-path decode throughput (first-ever tok/s rows for the KV-cache generate scan)"
 for dt in bf16 fp32; do
     # Full output to $LOG (tracebacks must survive a failed heal-window
-    # step); JSON rows additionally extracted into the perf artifact.
+    # step); JSON rows additionally extracted into the perf artifact
+    # (.jsonl — one JSON object per line, named to match its format).
     timeout 900 python scripts/decode_bench.py --dtype $dt 2>&1 | tee -a "$LOG" \
-        | grep '^{' >> perf/decode_bench_${FTS}.json
+        | grep '^{' >> perf/decode_bench_${FTS}.jsonl
 done
-[ -s perf/decode_bench_${FTS}.json ] || say "decode bench produced no rows — see $LOG"
+[ -s perf/decode_bench_${FTS}.jsonl ] || say "decode bench produced no rows — see $LOG"
 
 say "b=1 fresh-process repeatability diagnostic (3 back-to-back runs of the worst spread cell)"
 # The 2026-07-31 two-session spread check failed ONLY on b=1 cells (34-86%,

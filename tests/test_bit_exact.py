@@ -20,6 +20,8 @@ disagreed structurally (the CUDA LRN drops the /N scale entirely —
 v3_cuda_only/src/layers_cuda.cu:139 vs v1_serial/src/layers_serial.cpp:151).
 """
 
+import warnings
+
 import jax
 import numpy as np
 import pytest
@@ -132,9 +134,19 @@ def test_pallas_tier_sharding_under_g8(workload, monkeypatch, n):
     )
     if n == 3:  # odd-start shard: reduction-order tolerance, not bitwise
         np.testing.assert_allclose(got, single, rtol=2e-6, atol=2e-6)
-        assert (got != single).any(), (
-            "n=3 now matches bitwise — the parity sensitivity is gone; "
-            "tighten this branch back to assert_array_equal"
-        )
+        if not (got != single).any():
+            # Canary, not a gate (ADVICE round-5 item 2): the drift is a
+            # measured property of the CPU-interpret backend's reduction
+            # grouping, not a contract — a JAX/XLA upgrade that happens to
+            # make the odd-start shard bitwise-equal is a numerics
+            # IMPROVEMENT and must not hard-fail CI. The warning keeps the
+            # signal: when it fires on the measuring backend, tighten this
+            # branch back to assert_array_equal.
+            warnings.warn(
+                f"n=3 now matches bitwise on backend {jax.default_backend()!r}"
+                " — the g8 parity sensitivity is gone; tighten this branch "
+                "back to assert_array_equal",
+                RuntimeWarning,
+            )
     else:
         np.testing.assert_array_equal(got, single)

@@ -140,9 +140,13 @@ def test_session_csv_schema(tmp_path):
     with open(session.csv_path) as f:
         rows = list(csv.reader(f))
     assert rows[0] == harness.CSV_COLUMNS
-    assert len(rows[0]) == 20  # the reference's 20-column schema
+    # The reference's 20-column schema + the 2 resilience attempt-metadata
+    # columns (appended, so historical column indexes are untouched).
+    assert len(rows[0]) == 22
+    assert rows[0][20:] == ["Attempts", "ResilienceMsg"]
     assert rows[1][4] == "V1 Serial"
     assert rows[1][14] == harness.OK
+    assert rows[1][20] == "1"  # single attempt, no retries
 
 
 def test_run_case_subprocess_sweep(tmp_path):

@@ -54,3 +54,33 @@ def test_lint_noqa_suppresses(tmp_path):
         timeout=60,
     )
     assert proc.returncode == 0, proc.stdout
+
+
+def test_lint_raw_subprocess_scoped_to_transport_dirs(tmp_path):
+    """Bare subprocess execution is flagged ONLY under parallel//scripts/
+    (where it bypasses the retrying transport); elsewhere it is fine, and
+    a deliberate bounded call site opts out with # noqa: raw-subprocess."""
+    src = (
+        "import subprocess\n"
+        "subprocess.run(['true'])\n"
+        "subprocess.Popen(['true'])  # noqa: raw-subprocess\n"
+    )
+    scoped = tmp_path / "scripts" / "bad.py"
+    scoped.parent.mkdir()
+    scoped.write_text(src)
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "lint.py"), str(scoped)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert proc.stdout.count("[raw-subprocess]") == 1  # the noqa line is exempt
+    assert ":2:" in proc.stdout  # the bare run() call
+
+    unscoped = tmp_path / "elsewhere" / "ok.py"
+    unscoped.parent.mkdir()
+    unscoped.write_text(src)
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "lint.py"), str(unscoped)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout
