@@ -25,6 +25,16 @@ Tunables (env): BENCH_CONFIG (v1_jit), BENCH_COMPUTE (fp32|bf16), BENCH_BATCH
 BENCH_BF16 (1 — also measure a bf16 headline sub-object when the primary is
 fp32), BENCH_PROBE_TIMEOUT (120 s), BENCH_TIMEOUT (900 s),
 BENCH_PEAK_TFLOPS (197 — TPU v5e bf16 MXU peak).
+
+Multi-config sweep: BENCH_CONFIGS="v1_jit,v3_pallas,..." emits ONE JSON row
+PER config (same schema each) so the V1->V5 story is actually benchmarked,
+not just the headline config. Default (unset) stays the historical single
+BENCH_CONFIG row.
+
+Tuning: BENCH_PLAN=<tune_plan.json> loads a TunePlan (docs/TUNING.md); each
+row then carries ``plan_hash`` and a ``tuned_vs_default`` sub-object with
+both per-pass times, so tuned adoption is judged from measurements, not
+claims.
 """
 
 import json
@@ -37,6 +47,13 @@ BASELINE_IMG_PER_SEC = 1.0 / 0.183  # reference V4 best, RTX 3090 (BASELINE.md)
 METRIC = "alexnet_blocks12_images_per_sec"
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
+# Opt-in sweep: one JSON row per listed config (the V1->V5 story); unset =
+# the historical single-config contract.
+CONFIGS = [
+    c.strip() for c in os.environ.get("BENCH_CONFIGS", "").split(",") if c.strip()
+] or [CONFIG]
+# Opt-in TunePlan (docs/TUNING.md): rows gain plan_hash + tuned_vs_default.
+PLAN_PATH = os.environ.get("BENCH_PLAN", "")
 COMPUTE = os.environ.get("BENCH_COMPUTE", "fp32")
 # 128 is the round-over-round comparable default (advisor: the round-3
 # bump to 256 raised the headline via configuration, not code — sweeps opt
@@ -77,7 +94,7 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, ROOT)
 
 
-def _error_obj(msg: str, platform: str = "unknown") -> dict:
+def _error_obj(msg: str, platform: str = "unknown", config: str = None) -> dict:
     out = {
         "metric": METRIC,
         "value": 0.0,
@@ -85,7 +102,7 @@ def _error_obj(msg: str, platform: str = "unknown") -> dict:
         "vs_baseline": 0.0,
         "error": msg,
         "platform": platform,
-        "config": CONFIG,
+        "config": config or CONFIG,
         "compute": COMPUTE,
         "batch": BATCH,
     }
@@ -167,8 +184,29 @@ def _child() -> int:
     mxu_flops = matmul_flops_per_image()
     peak = peak_tflops(device.device_kind)
 
-    def measure(compute: str, batch: int = BATCH) -> dict:
-        fwd = build_forward(REGISTRY[CONFIG], compute=compute)
+    plan, plan_note = None, ""
+    if PLAN_PATH:
+        # A requested-but-unusable plan is a visible note on every row, never
+        # a silent fall-through to untuned numbers labeled tuned.
+        try:
+            from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+            from cuda_mpi_gpu_cluster_programming_tpu.tuning.plan import load_plan
+
+            plan = load_plan(
+                PLAN_PATH, device_kind=device.device_kind, model_cfg=BLOCKS12,
+                dtype=COMPUTE, batch=BATCH,
+            )
+            if plan is None:
+                plan_note = f"no matching plan in {PLAN_PATH} (untuned)"
+        except Exception as e:
+            plan_note = f"plan load failed: {type(e).__name__}: {e}"[:160]
+
+    def measure(compute: str, batch: int = BATCH, config: str = CONFIG,
+                use_plan: bool = True) -> dict:
+        fwd = build_forward(
+            REGISTRY[config], compute=compute,
+            plan=plan if use_plan else None,
+        )
         xb = x if batch == BATCH else deterministic_input(batch=batch)
         # Amortized fenced timing with a 100 ms work floor: on the tunneled
         # TPU, block_until_ready alone over-reports throughput by orders of
@@ -211,60 +249,98 @@ def _child() -> int:
             "timing_underconverged": st.underconverged,
         }
 
-    row = measure(COMPUTE)
-    out = {
-        "metric": METRIC,
-        **row,
-        "assumed_peak_tflops": peak if platform != "cpu" else None,
-        "device_kind": device.device_kind,
-        "flops_per_image": flops_per_image(),
-        "matmul_flops_per_image": mxu_flops,
-        "platform": platform,
-        "config": CONFIG,
-        "batch": BATCH,
-    }
-    # Flush the completed primary immediately: if the optional bf16 pass
-    # below pushes the child past BENCH_TIMEOUT, the parent salvages this
-    # line from the killed child's partial stdout instead of reporting 0.0.
-    print(json.dumps(out), flush=True)
-    # bf16 headline alongside the fp32 apples-to-apples row (round-3 verdict:
-    # the committed headline was fp32-only; the bf16 sub-object states the
-    # chip's actual capability, with its own MFU and n/CI fields). Skipped
-    # when the primary already is bf16 or on CPU (no second tier to show).
-    if COMPUTE == "fp32" and platform != "cpu" and os.environ.get("BENCH_BF16", "1") != "0":
-        # Never let the optional secondary destroy the completed primary: a
-        # bf16 failure (unsupported config, relay hiccup, mid-run wedge)
-        # degrades to an error note, not a value:0.0 round record.
+    for cfg_key in CONFIGS:
+        # One row per config (BENCH_CONFIGS sweep; default = the single
+        # historical row). A config that fails to build/measure yields an
+        # error row and the sweep keeps going — one broken tier must not
+        # erase the others' fresh measurements.
         try:
-            out["bf16"] = measure("bf16")
+            row = measure(COMPUTE, config=cfg_key)
         except Exception as e:
-            out["bf16"] = {"error": f"{type(e).__name__}: {e}"[:200]}
-        print(json.dumps(out), flush=True)  # last line wins in the parent
-    # Continuity row (round-4 verdict weak item 2): when the committed
-    # last_good was captured at a DIFFERENT batch than today's default, the
-    # parent asks for one extra row at that batch so the fresh capture is
-    # directly comparable with the stale headline it replaces. Optional and
-    # last: its failure degrades to a note, never the primary.
-    cont = int(os.environ.get("BENCH_CONTINUITY_BATCH", "0"))
-    if cont and cont != BATCH and platform != "cpu":
-        try:
-            out[f"continuity_b{cont}"] = {**measure(COMPUTE, batch=cont), "batch": cont}
-        except Exception as e:
-            out[f"continuity_b{cont}"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            print(
+                json.dumps(
+                    _error_obj(f"{type(e).__name__}: {e}"[:200], platform, cfg_key)
+                ),
+                flush=True,
+            )
+            continue
+        out = {
+            "metric": METRIC,
+            **row,
+            "assumed_peak_tflops": peak if platform != "cpu" else None,
+            "device_kind": device.device_kind,
+            "flops_per_image": flops_per_image(),
+            "matmul_flops_per_image": mxu_flops,
+            "platform": platform,
+            "config": cfg_key,
+            "batch": BATCH,
+        }
+        if plan is not None:
+            # Tuned-vs-default on the SAME estimator: the headline row above
+            # ran under the plan; re-measure with the plan stripped so the
+            # delta is two measurements, not a claim. (The reference tier
+            # ignores the plan — its delta documents exactly that.)
+            out["plan_hash"] = plan.plan_hash()
+            try:
+                default_row = measure(COMPUTE, config=cfg_key, use_plan=False)
+                tuned_ms = row["per_pass_ms"]
+                default_ms = default_row["per_pass_ms"]
+                out["tuned_vs_default"] = {
+                    "tuned_per_pass_ms": tuned_ms,
+                    "default_per_pass_ms": default_ms,
+                    "speedup": round(default_ms / tuned_ms, 4) if tuned_ms else None,
+                }
+            except Exception as e:
+                out["tuned_vs_default"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        elif plan_note:
+            out["plan_error"] = plan_note
+        # Flush the completed primary immediately: if the optional bf16 pass
+        # below pushes the child past BENCH_TIMEOUT, the parent salvages this
+        # line from the killed child's partial stdout instead of reporting 0.0.
         print(json.dumps(out), flush=True)
+        # bf16 headline alongside the fp32 apples-to-apples row (round-3
+        # verdict: the committed headline was fp32-only; the bf16 sub-object
+        # states the chip's actual capability, with its own MFU and n/CI
+        # fields). Skipped when the primary already is bf16 or on CPU (no
+        # second tier to show).
+        if COMPUTE == "fp32" and platform != "cpu" and os.environ.get("BENCH_BF16", "1") != "0":
+            # Never let the optional secondary destroy the completed primary:
+            # a bf16 failure (unsupported config, relay hiccup, mid-run
+            # wedge) degrades to an error note, not a value:0.0 round record.
+            try:
+                out["bf16"] = measure("bf16", config=cfg_key)
+            except Exception as e:
+                out["bf16"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            print(json.dumps(out), flush=True)  # newest line per config wins
+        # Continuity row (round-4 verdict weak item 2): when the committed
+        # last_good was captured at a DIFFERENT batch than today's default,
+        # the parent asks for one extra row at that batch so the fresh
+        # capture is directly comparable with the stale headline it
+        # replaces. Optional and last (single-config mode only — the sweep's
+        # rows are each their own story): failure degrades to a note.
+        cont = int(os.environ.get("BENCH_CONTINUITY_BATCH", "0"))
+        if cont and cont != BATCH and platform != "cpu" and len(CONFIGS) == 1:
+            try:
+                out[f"continuity_b{cont}"] = {
+                    **measure(COMPUTE, batch=cont, config=cfg_key), "batch": cont
+                }
+            except Exception as e:
+                out[f"continuity_b{cont}"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            print(json.dumps(out), flush=True)
     return 0
 
 
-def _measure_once() -> dict:
-    """One full probe+measure pass; returns the JSON object to emit (an
-    ``error`` field marks a failed/wedged pass the retry loop may re-run)."""
+def _measure_once() -> list:
+    """One full probe+measure pass; returns the JSON row list to emit, one
+    row per BENCH_CONFIGS entry (an ``error`` field marks a failed/wedged
+    row the retry loop may re-run)."""
     here = os.path.dirname(os.path.abspath(__file__))
     # 1) Bounded device probe: a wedged tunnel hangs on the tiniest matmul.
     from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
 
     ok, info = probe(PROBE_TIMEOUT)
     if not ok:
-        return _error_obj(f"device {info}")
+        return [_error_obj(f"device {info}", config=c) for c in CONFIGS]
     platform = info
 
     # Auto-request a continuity row when the committed headline was captured
@@ -306,45 +382,61 @@ def _measure_once() -> dict:
         timed_out = True
         proc.kill()
         stdout, stderr = proc.communicate()
-    # Any PARSEABLE row beats the error JSON — a child that flushed the
+    # Any PARSEABLE row beats the error JSON — a child that flushed a
     # primary and then died in the optional bf16 pass (timeout, backend
-    # crash, rc!=0) still produced a valid fresh measurement. A SIGKILL can
-    # truncate the final line mid-write, so scan backwards for the newest
-    # line that actually parses (the flushed primary is always complete).
-    salvaged = None
-    for line in reversed((stdout or "").splitlines()):
-        if line.startswith("{"):
-            try:
-                salvaged = json.loads(line)
-                break
-            except ValueError:
-                continue
-    if salvaged is not None:
-        if timed_out or proc.returncode != 0:
-            # Annotate so the record shows bf16 was attempted and died,
-            # not deliberately skipped.
-            why = (
-                f"timed out after {BENCH_TIMEOUT:.0f}s"
-                if timed_out
-                else f"rc={proc.returncode}"
-            )
-            salvaged["salvaged"] = f"child killed after primary row ({why})"
-        return salvaged
+    # crash, rc!=0) still produced a valid fresh measurement. The newest
+    # parseable line PER CONFIG wins (a SIGKILL can truncate the final line
+    # mid-write; flushed primaries are always complete); configs the child
+    # never reached become error rows.
+    by_config = {}
+    for line in (stdout or "").splitlines():
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        by_config[obj.get("config")] = obj  # later lines overwrite
+    died = timed_out or proc.returncode != 0
+    why = (
+        f"timed out after {BENCH_TIMEOUT:.0f}s" if timed_out
+        else f"rc={proc.returncode}"
+    )
+    if any(c in by_config for c in CONFIGS):
+        rows = []
+        for c in CONFIGS:
+            row = by_config.get(c)
+            if row is None:
+                rows.append(_error_obj(f"child died before {c} ({why})", platform, c))
+            else:
+                if died:
+                    # Annotate so the record shows later passes were
+                    # attempted and died, not deliberately skipped.
+                    row["salvaged"] = f"child killed mid-sweep ({why})"
+                rows.append(row)
+        return rows
     if timed_out:
-        return _error_obj(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform)
+        return [
+            _error_obj(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform, c)
+            for c in CONFIGS
+        ]
     tail = ((stderr or stdout or "").strip().splitlines() or ["no output"])[-1:]
-    return _error_obj(f"benchmark failed (rc={proc.returncode}): {tail[0]}", platform)
+    return [
+        _error_obj(f"benchmark failed (rc={proc.returncode}): {tail[0]}", platform, c)
+        for c in CONFIGS
+    ]
 
 
 def main() -> int:
     """Bounded wedge-aware re-capture around ``_measure_once``.
 
-    A pass that measured nothing (``error`` field, or a ``value`` of 0.0 —
-    the wedged-tunnel signature that silently destroyed four rounds of
-    headline evidence) is retried with backoff up to BENCH_MAX_RETRIES
-    (default 1) within BENCH_DEADLINE_S; the emitted JSON then carries
-    ``attempts`` / ``resilience`` metadata so retried rows are labeled.
-    Still always prints exactly ONE parseable JSON line and exits 0.
+    A pass with any row that measured nothing (``error`` field, or a
+    ``value`` of 0.0 — the wedged-tunnel signature that silently destroyed
+    four rounds of headline evidence) is retried with backoff up to
+    BENCH_MAX_RETRIES (default 1) within BENCH_DEADLINE_S; the emitted JSON
+    then carries ``attempts`` / ``resilience`` metadata so retried rows are
+    labeled. Always prints exactly ONE parseable JSON line per config
+    (historically: one config, one line) and exits 0.
     """
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,
@@ -359,28 +451,38 @@ def main() -> int:
     )
     deadline = Deadline.after(float(os.environ.get("BENCH_DEADLINE_S", "0")) or None)
     flog = FaultLog(site="bench")
-    out: dict = {}
-    for attempt in range(max(0, policy.max_retries) + 1):
-        t0 = time.monotonic()
-        out = _measure_once()
-        value = out.get("value")
-        wedged = bool(out.get("error")) or not (
+
+    def _row_wedged(row: dict) -> bool:
+        value = row.get("value")
+        return bool(row.get("error")) or not (
             isinstance(value, (int, float)) and value > 0
         )
-        if not wedged:
+
+    rows: list = []
+    for attempt in range(max(0, policy.max_retries) + 1):
+        t0 = time.monotonic()
+        rows = _measure_once()
+        bad = [r for r in rows if _row_wedged(r)]
+        if not bad:
             flog.record("ok", duration_s=time.monotonic() - t0)
             break
-        cause = str(out.get("error") or f"value={value!r} (wedged capture)")[:160]
+        cause = str(
+            bad[0].get("error")
+            or f"value={bad[0].get('value')!r} (wedged capture)"
+        )[:160]
+        if len(bad) > 1:
+            cause += f" (+{len(bad) - 1} more rows)"
         if attempt >= policy.max_retries or deadline.expired:
             flog.record("fail", cause, time.monotonic() - t0)
             break
         pause = min(policy.delay_s(attempt + 1), deadline.remaining())
         flog.record("retry", cause, time.monotonic() - t0, backoff_s=pause)
         time.sleep(pause)
-    out["attempts"] = flog.n_attempts
-    if flog.retried:
-        out["resilience"] = flog.summary()
-    print(json.dumps(out))
+    for row in rows:
+        row["attempts"] = flog.n_attempts
+        if flog.retried:
+            row["resilience"] = flog.summary()
+        print(json.dumps(row))
     return 0
 
 

@@ -130,12 +130,29 @@ REGISTRY: Dict[str, ExecConfig] = {
 }
 
 
+def _resolve_variants(plan):
+    """Env-resolved variants, overlaid with a TunePlan's per-layer winners
+    when one is supplied. Precedence per knob: explicit env var beats the
+    tuned plan beats the code default (tuning.plan.effective_layer_variants
+    is the one implementation)."""
+    from .ops.pallas_kernels import KernelVariants
+
+    kv = KernelVariants.resolve()
+    if plan is None:
+        return kv
+    from .tuning.plan import effective_layer_variants
+
+    return effective_layer_variants(plan, base=kv)
+
+
 def build_forward(
     exec_cfg: ExecConfig,
     model_cfg=None,
     n_shards: int = 1,
     mesh: Optional[jax.sharding.Mesh] = None,
     compute: str = "fp32",
+    plan=None,
+    donate: bool = False,
 ) -> Callable:
     """Return a jitted ``(params, x) -> out`` for the given execution config.
 
@@ -147,10 +164,27 @@ def build_forward(
     accumulation on the MXU, fp32 output — the TPU-native perf mode; halves
     HBM traffic and engages the MXU's fast path. No reference analogue:
     CUDA stages are fp32-only).
+    ``plan``: a ``tuning.plan.TunePlan`` whose per-layer kernel variants the
+    Pallas tiers run with (reference tiers ignore it); explicit env knobs
+    still win — see docs/TUNING.md.
+    ``donate``: donate the input-activation buffer to the computation
+    (single-device tiers; halves peak HBM for the activation at the cost of
+    consuming ``x`` — callers that re-invoke with the same array, e.g. the
+    amortized timing chains, must leave this off).
     """
     if compute not in ("fp32", "bf16"):
         raise ValueError(f"unknown compute mode {compute!r} (fp32|bf16)")
-    fwd = _build_forward_fp32(exec_cfg, model_cfg, n_shards, mesh)
+    # Persistent XLA compile cache (the prebuilt-binaries analogue), wired
+    # at build time so EVERY builder caller — tuner candidates included —
+    # gets it, not just the run/bench entry mains. Never fatal: a read-only
+    # FS degrades to uncached compiles.
+    try:
+        from .utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
+    except Exception:
+        pass
+    fwd = _build_forward_fp32(exec_cfg, model_cfg, n_shards, mesh, plan, donate)
     if compute == "fp32":
         return fwd
     import jax.numpy as jnp
@@ -159,7 +193,13 @@ def build_forward(
         pb = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
         return fwd(pb, x.astype(jnp.bfloat16)).astype(jnp.float32)
 
-    return jax.jit(fwd_bf16)
+    return _jit(fwd_bf16, donate)
+
+
+def _jit(fn: Callable, donate: bool) -> Callable:
+    # Donation argnums: 1 is the activation input x of (params, x). Params
+    # are never donated — every caller reuses them across passes.
+    return jax.jit(fn, donate_argnums=(1,) if donate else ())
 
 
 def _build_forward_fp32(
@@ -167,6 +207,8 @@ def _build_forward_fp32(
     model_cfg=None,
     n_shards: int = 1,
     mesh: Optional[jax.sharding.Mesh] = None,
+    plan=None,
+    donate: bool = False,
 ) -> Callable:
     need = n_shards if exec_cfg.strategy != "single" else 1
     if mesh is None and jax.device_count() < need:
@@ -182,17 +224,18 @@ def _build_forward_fp32(
         model_cfg = model_cfg or ALEXNET
         if exec_cfg.strategy == "single":
             if exec_cfg.tier == "pallas":
-                from .ops.pallas_kernels import KernelVariants
                 from .ops.pallas_model import forward_alexnet_pallas
 
                 # Resolve lowering variants NOW: each build_forward call
                 # re-reads the env, so the A/B workflow is build-per-variant
-                # instead of the round-3 process-per-variant footgun.
-                kv = KernelVariants.resolve()
-                return jax.jit(
-                    lambda p, x: forward_alexnet_pallas(p, x, model_cfg, variants=kv)
+                # instead of the round-3 process-per-variant footgun. A
+                # TunePlan overlays per-layer winners (env still wins).
+                kv = _resolve_variants(plan)
+                return _jit(
+                    lambda p, x: forward_alexnet_pallas(p, x, model_cfg, variants=kv),
+                    donate,
                 )
-            return jax.jit(lambda p, x: forward_alexnet(p, x, model_cfg))
+            return _jit(lambda p, x: forward_alexnet(p, x, model_cfg), donate)
         if exec_cfg.strategy in ("halo", "staged_halo"):
             from .models.alexnet_full import fc_head
             from .parallel.sharded import build_sharded_forward
@@ -212,17 +255,17 @@ def _build_forward_fp32(
     model_cfg = model_cfg or BLOCKS12
     if exec_cfg.strategy == "single":
         if exec_cfg.tier == "pallas":
-            from .ops.pallas_kernels import KernelVariants
             from .ops.pallas_model import _chain_variant, forward_blocks12_pallas
 
-            kv = KernelVariants.resolve()  # eager: see alexnet_full branch
+            kv = _resolve_variants(plan)  # eager: see alexnet_full branch
             ch = _chain_variant()
-            return jax.jit(
+            return _jit(
                 lambda p, x: forward_blocks12_pallas(
                     p, x, model_cfg, variants=kv, chain=ch
-                )
+                ),
+                donate,
             )
-        return jax.jit(lambda p, x: forward_blocks12(p, x, model_cfg))
+        return _jit(lambda p, x: forward_blocks12(p, x, model_cfg), donate)
 
     if exec_cfg.strategy == "replicated":
         from .parallel.replicated import build_replicated_forward

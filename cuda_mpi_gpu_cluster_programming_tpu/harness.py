@@ -65,6 +65,7 @@ CSV_COLUMNS = [
     "LogFile",
     "Attempts",
     "ResilienceMsg",
+    "PlanHash",
 ]
 
 # Exit-code triage classes (common_test_utils.sh:96-116); DEGRADED comes
@@ -206,6 +207,10 @@ _RE_FIRST = re.compile(r"Final Output \(first 10 values\): (.+)")
 # Structured fallback event printed by the run CLI's Degrader
 # (resilience.policy.DegradedEvent.__str__).
 _RE_DEGRADED = re.compile(r"^DEGRADED\(.+?\): .*$", re.MULTILINE)
+# Tuning-plan identity printed by the run CLI (run.py "Tune plan:" line):
+# rows measured under a tuned per-layer variant plan carry its hash, so a
+# tuned number can never masquerade as a default-lowering one in the CSV.
+_RE_PLAN = re.compile(r"^Tune plan: (?:cache|swept|loaded) hash=([0-9a-f]+)", re.MULTILINE)
 
 
 def is_wedged(r: CaseResult, log_text: str) -> bool:
@@ -240,6 +245,7 @@ class CaseResult:
     attempts: int = 1
     resilience_msg: str = ""  # retry/suppression trail (FaultLog.summary)
     degraded_msg: str = ""  # the run CLI's DEGRADED(from -> to) event line
+    plan_hash: str = ""  # TunePlan identity the run measured under ("" = untuned)
 
     @property
     def status(self) -> str:
@@ -350,6 +356,7 @@ class Session:
                     r.log_file,
                     r.attempts,
                     r.resilience_msg or r.degraded_msg,
+                    r.plan_hash,
                 ]
             )
 
@@ -425,6 +432,9 @@ def _run_once(
         m = _RE_DEGRADED.search(text)
         if m:
             r.degraded_msg = m.group(0)[:200]
+        m = _RE_PLAN.search(text)
+        if m:
+            r.plan_hash = m.group(1)
     return text
 
 
@@ -615,6 +625,13 @@ def make_parser() -> argparse.ArgumentParser:
         "or 'auto' for the canonical tier ladder; failed cases re-run on the "
         "next tier and triage as DEGRADED instead of failing",
     )
+    p.add_argument(
+        "--plan",
+        default="",
+        help="TunePlan JSON path forwarded to every case's run CLI; each "
+        "row's PlanHash column records the plan it actually measured under "
+        "(docs/TUNING.md)",
+    )
     return p
 
 
@@ -642,6 +659,8 @@ def main(argv=None) -> int:
     extra = ["--height", str(args.height), "--width", str(args.width), "--repeats", str(args.repeats)]
     if args.fallback_chain:
         extra += ["--fallback-chain", args.fallback_chain]
+    if args.plan:
+        extra += ["--plan", args.plan]
     policy = RetryPolicy(max_retries=max(0, args.max_retries), base_delay_s=args.retry_backoff)
     deadline = Deadline.after(args.deadline_s or None)
     results: List[CaseResult] = []

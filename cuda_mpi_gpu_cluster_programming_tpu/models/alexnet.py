@@ -76,21 +76,36 @@ class Blocks12Config:
 BLOCKS12 = Blocks12Config()
 
 
-def output_shape(cfg: Blocks12Config = BLOCKS12) -> Tuple[int, int, int]:
-    """(H, W, C) of the final output — 13x13x256 for the defaults.
+def layer_dims(cfg):
+    """Walk the layer chain once, yielding ``(name, spec, in_dims, out_dims)``
+    with dims as (H, W, C) — the ONE output-shape traversal shared by
+    ``output_shape``, the FLOP counters and the kernel autotuner (each used
+    to re-implement this loop; tuning geometry must not drift from the FLOP
+    accounting it is judged by).
 
+    Works for any config exposing ``layer_chain()`` plus input dims
+    (Blocks12Config and the full AlexNetConfig's spatial chain alike).
     Mirrors the dim chain at v2_mpi_only/2.2_scatter_halo/src/main.cpp:49-58.
     """
-    h, w = cfg.in_height, cfg.in_width
-    h = conv_out_dim(h, cfg.conv1.filter_size, cfg.conv1.padding, cfg.conv1.stride)
-    w = conv_out_dim(w, cfg.conv1.filter_size, cfg.conv1.padding, cfg.conv1.stride)
-    h = pool_out_dim(h, cfg.pool1.window, cfg.pool1.stride)
-    w = pool_out_dim(w, cfg.pool1.window, cfg.pool1.stride)
-    h = conv_out_dim(h, cfg.conv2.filter_size, cfg.conv2.padding, cfg.conv2.stride)
-    w = conv_out_dim(w, cfg.conv2.filter_size, cfg.conv2.padding, cfg.conv2.stride)
-    h = pool_out_dim(h, cfg.pool2.window, cfg.pool2.stride)
-    w = pool_out_dim(w, cfg.pool2.window, cfg.pool2.stride)
-    return h, w, cfg.conv2.out_channels
+    h, w, c = cfg.in_height, cfg.in_width, cfg.in_channels
+    for name, spec in cfg.layer_chain():
+        hin, win, cin = h, w, c
+        if isinstance(spec, ConvSpec):
+            h = conv_out_dim(h, spec.filter_size, spec.padding, spec.stride)
+            w = conv_out_dim(w, spec.filter_size, spec.padding, spec.stride)
+            c = spec.out_channels
+        elif isinstance(spec, PoolSpec):
+            h = pool_out_dim(h, spec.window, spec.stride)
+            w = pool_out_dim(w, spec.window, spec.stride)
+        yield name, spec, (hin, win, cin), (h, w, c)
+
+
+def output_shape(cfg: Blocks12Config = BLOCKS12) -> Tuple[int, int, int]:
+    """(H, W, C) of the final output — 13x13x256 for the defaults."""
+    dims = cfg.in_height, cfg.in_width, cfg.in_channels
+    for _name, _spec, _in, dims in layer_dims(cfg):
+        pass
+    return dims
 
 
 def flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
@@ -101,24 +116,17 @@ def flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
     "~0.33 GFLOPs" for the same workload; that figure undercounts (it is not
     reproducible from the layer dims), so we derive from the config instead.
     """
-    h, w = cfg.in_height, cfg.in_width
     total = 0
-    c_in = cfg.in_channels
-    for name, spec in cfg.layer_chain():
+    for _name, spec, (_hi, _wi, c_in), (h, w, c_out) in layer_dims(cfg):
         if isinstance(spec, ConvSpec):
-            h = conv_out_dim(h, spec.filter_size, spec.padding, spec.stride)
-            w = conv_out_dim(w, spec.filter_size, spec.padding, spec.stride)
-            macs = h * w * spec.out_channels * spec.filter_size**2 * c_in
-            total += 2 * macs + h * w * spec.out_channels  # +bias add, +ReLU
-            c_in = spec.out_channels
+            macs = h * w * c_out * spec.filter_size**2 * c_in
+            total += 2 * macs + h * w * c_out  # +bias add, +ReLU
         elif isinstance(spec, PoolSpec):
-            h = pool_out_dim(h, spec.window, spec.stride)
-            w = pool_out_dim(w, spec.window, spec.stride)
-            total += h * w * c_in * spec.window**2  # window max compares
+            total += h * w * c_out * spec.window**2  # window max compares
         elif isinstance(spec, LrnSpec):
             # per element: ~size multiplies + adds for the window sum, plus
             # the scale power and divide
-            total += h * w * c_in * (2 * spec.size + 2)
+            total += h * w * c_out * (2 * spec.size + 2)
     return total
 
 
@@ -129,18 +137,10 @@ def matmul_flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
     compares, LRN window sums, bias adds and ReLU are excluded —
     ``flops_per_image`` keeps the all-in count for throughput accounting.
     """
-    h, w = cfg.in_height, cfg.in_width
     total = 0
-    c_in = cfg.in_channels
-    for _name, spec in cfg.layer_chain():
+    for _name, spec, (_hi, _wi, c_in), (h, w, c_out) in layer_dims(cfg):
         if isinstance(spec, ConvSpec):
-            h = conv_out_dim(h, spec.filter_size, spec.padding, spec.stride)
-            w = conv_out_dim(w, spec.filter_size, spec.padding, spec.stride)
-            total += 2 * h * w * spec.out_channels * spec.filter_size**2 * c_in
-            c_in = spec.out_channels
-        elif isinstance(spec, PoolSpec):
-            h = pool_out_dim(h, spec.window, spec.stride)
-            w = pool_out_dim(w, spec.window, spec.stride)
+            total += 2 * h * w * c_out * spec.filter_size**2 * c_in
     return total
 
 

@@ -29,7 +29,6 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import reference as ops
-from ..ops.shapes import conv_out_dim, pool_out_dim
 from .alexnet import BLOCKS12, Blocks12Config, ConvSpec, LrnSpec, PoolSpec
 
 Params = Dict[str, Dict[str, Any]]
@@ -77,15 +76,12 @@ ALEXNET = AlexNetConfig()
 
 def spatial_output_shape(cfg: AlexNetConfig = ALEXNET) -> Tuple[int, int, int]:
     """(H, W, C) after pool5 — 6x6x256 for the defaults (summary.md:29-45)."""
-    h, w = cfg.in_height, cfg.in_width
-    for _, spec in cfg.layer_chain():
-        if isinstance(spec, ConvSpec):
-            h = conv_out_dim(h, spec.filter_size, spec.padding, spec.stride)
-            w = conv_out_dim(w, spec.filter_size, spec.padding, spec.stride)
-        elif isinstance(spec, PoolSpec):
-            h = pool_out_dim(h, spec.window, spec.stride)
-            w = pool_out_dim(w, spec.window, spec.stride)
-    return h, w, cfg.conv5.out_channels
+    from .alexnet import layer_dims
+
+    dims = cfg.in_height, cfg.in_width, cfg.in_channels
+    for _name, _spec, _in, dims in layer_dims(cfg):
+        pass
+    return dims
 
 
 def forward_spatial(params: Params, x: jax.Array, cfg: AlexNetConfig = ALEXNET) -> jax.Array:

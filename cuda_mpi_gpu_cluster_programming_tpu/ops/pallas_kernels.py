@@ -177,7 +177,8 @@ def _warn_k_block_dropped(k_block: int, kk: int) -> None:
     warnings.warn(
         f"requested k_block={k_block} does not apply to K={kk} (needs "
         f"K % k_block == 0 and K > k_block) — this conv runs UNBLOCKED; "
-        "label its A/B rows kb=0",
+        "label its A/B rows kb=0 (KernelVariants.bind(K) makes the repr "
+        f"state this: kb={k_block}->0(K={kk}))",
         RuntimeWarning,
         stacklevel=3,
     )
@@ -207,6 +208,13 @@ class KernelVariants(NamedTuple):
     row_block: int = _ROW_BLOCK
     k_block: int = 0
     fuse: str = "none"
+    # Layer-binding metadata, NOT a lowering knob: the conv's output-channel
+    # count when the variants are bound to one layer (``bind``; the tuner's
+    # per-layer plans always bind). 0 = unbound/process-global. Lets the repr
+    # state the EFFECTIVE k_block next to the requested one, so tuner logs
+    # and A/B rows are self-labeling even though _warn_k_block_dropped fires
+    # only once per process.
+    k_channels: int = 0
 
     @classmethod
     def resolve(cls) -> "KernelVariants":
@@ -214,6 +222,60 @@ class KernelVariants(NamedTuple):
             conv=_conv_variant(), pool=_pool_variant(), row_block=_row_block(),
             k_block=_k_block(), fuse=_fuse_variant(),
         )
+
+    def bind(self, k_channels: int) -> "KernelVariants":
+        """The same knobs bound to a conv with K output channels."""
+        return self._replace(k_channels=k_channels)
+
+    def knobs(self) -> "KernelVariants":
+        """The lowering knobs alone (binding stripped) — the equality the
+        tuner's candidate dedup and tests should compare on."""
+        return self._replace(k_channels=0)
+
+    @property
+    def effective_k_block(self) -> int:
+        """The k_block that actually applies at K=k_channels (the geometry
+        gate in _conv2d_pallas: K % k_block == 0 and K > k_block, else the
+        conv runs unblocked). Unbound variants report the requested value —
+        only a bound layer has a geometry to judge against. The hardware
+        lane rule (k_block % 128) is NOT folded in: on chip that case
+        raises rather than silently degrading."""
+        if not self.k_block or not self.k_channels:
+            return self.k_block
+        if self.k_channels % self.k_block == 0 and self.k_channels > self.k_block:
+            return self.k_block
+        return 0
+
+    def label(self) -> str:
+        """Compact A/B-row/tuner-log label; requested->effective k_block is
+        spelled out when a bound geometry drops the request."""
+        kb = str(self.k_block)
+        if self.k_channels and self.effective_k_block != self.k_block:
+            kb = f"{self.k_block}->{self.effective_k_block}(K={self.k_channels})"
+        return (
+            f"conv={self.conv} pool={self.pool} rb={self.row_block} "
+            f"kb={kb} fuse={self.fuse}"
+        )
+
+    def __repr__(self) -> str:
+        return f"KernelVariants({self.label()})"
+
+
+class LayerVariants(NamedTuple):
+    """Per-layer lowering plan — the tuner's product (tuning/). Variants are
+    no longer process-global: each conv layer (and the pool it feeds) can
+    carry its own ``KernelVariants``. Hashable like KernelVariants, so a
+    plan can ride closures/static args the same way. Forward builders accept
+    either type; ``ops.pallas_model._layer_variants`` dispatches."""
+
+    layers: tuple = ()  # ((layer_name, KernelVariants), ...)
+    default: KernelVariants = KernelVariants()
+
+    def for_layer(self, name: str) -> KernelVariants:
+        for n, v in self.layers:
+            if n == name:
+                return v
+        return self.default
 
 
 def _mxu_precision(dtype):

@@ -44,6 +44,15 @@ def _pad_axis(a: jax.Array, axis: int, to: int) -> jax.Array:
     return jnp.pad(a, widths)
 
 
+def _layer_variants(v, name: str) -> "pk.KernelVariants":
+    """Dispatch a variants argument that is either one process-global
+    ``KernelVariants`` (the historical shape) or a per-layer
+    ``LayerVariants`` plan (the tuner's product) down to ONE layer's
+    resolved knobs — the single point where the per-layer refactor meets
+    the kernel wrappers."""
+    return v.for_layer(name) if isinstance(v, pk.LayerVariants) else v
+
+
 def _conv_then_pool(x, w, b, cspec, pspec, v: "pk.KernelVariants"):
     """conv(+relu) then max-pool, the ONE place that decides whether the
     pool's H stage rides the conv epilogue (``fuse="hpool"``) — both
@@ -78,14 +87,16 @@ def forward_blocks12_pallas(
     params,
     x: jax.Array,
     cfg: Blocks12Config = BLOCKS12,
-    variants: pk.KernelVariants | None = None,
+    variants: pk.KernelVariants | pk.LayerVariants | None = None,
     chain: str | None = None,
 ) -> jax.Array:
     """``variants``/``chain``: explicit lowering choices. Build-time callers
     (configs.build_forward) resolve them eagerly and pass them in, so the
     selection is part of the function they jit — re-building after an env
-    flip picks up the new variant (the round-3 footgun fix). Direct callers
-    may omit them (env/defaults resolve at trace time, as before)."""
+    flip picks up the new variant (the round-3 footgun fix). ``variants``
+    may be one KernelVariants for every layer or a per-layer LayerVariants
+    plan (tuning/). Direct callers may omit them (env/defaults resolve at
+    trace time, as before)."""
     v = variants if variants is not None else pk.KernelVariants.resolve()
     c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
     pad128 = (chain if chain is not None else _chain_variant()) == "pad128"
@@ -95,8 +106,8 @@ def forward_blocks12_pallas(
         kp = -(-w1.shape[-1] // 128) * 128  # conv1 output channels -> 128
         w1, b1 = _pad_axis(w1, 3, kp), _pad_axis(b1, 0, kp)
         w2 = _pad_axis(w2, 2, kp)  # conv2 contraction axis: zero rows
-    x = _conv_then_pool(x, w1, b1, c1, p1, v)
-    x = _conv_then_pool(x, w2, b2, c2, p2, v)
+    x = _conv_then_pool(x, w1, b1, c1, p1, _layer_variants(v, "conv1"))
+    x = _conv_then_pool(x, w2, b2, c2, p2, _layer_variants(v, "conv2"))
     x = pk.lrn_pallas(
         x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
     )
@@ -107,7 +118,7 @@ def forward_alexnet_pallas(
     params,
     x: jax.Array,
     cfg=None,
-    variants: pk.KernelVariants | None = None,
+    variants: pk.KernelVariants | pk.LayerVariants | None = None,
 ) -> jax.Array:
     """Full AlexNet on the Pallas tier: chain-driven spatial part (fused
     conv+bias+ReLU launches), then the shared MXU-matmul FC head.
@@ -122,13 +133,15 @@ def forward_alexnet_pallas(
     for idx, (name, spec) in enumerate(chain):
         if idx == skip_pool_idx:
             continue  # this pool was consumed by _conv_then_pool
+        lv = _layer_variants(v, name)
         if isinstance(spec, ConvSpec):
             nxt = chain[idx + 1][1] if idx + 1 < len(chain) else None
             if isinstance(nxt, PoolSpec):
                 # conv->pool adjacency: the shared helper owns the
-                # fuse="hpool" decision (one gate for both builders).
+                # fuse="hpool" decision (one gate for both builders); the
+                # conv's per-layer plan also governs the pool it feeds.
                 x = _conv_then_pool(
-                    x, params[name]["w"], params[name]["b"], spec, nxt, v
+                    x, params[name]["w"], params[name]["b"], spec, nxt, lv
                 )
                 skip_pool_idx = idx + 1
                 continue
@@ -139,13 +152,13 @@ def forward_alexnet_pallas(
                 stride=spec.stride,
                 padding=spec.padding,
                 relu=True,
-                variant=v.conv,
-                row_block=v.row_block,
-                k_block=v.k_block,
+                variant=lv.conv,
+                row_block=lv.row_block,
+                k_block=lv.k_block,
             )
         elif isinstance(spec, PoolSpec):
             x = pk.maxpool_pallas(
-                x, window=spec.window, stride=spec.stride, variant=v.pool
+                x, window=spec.window, stride=spec.stride, variant=lv.pool
             )
         elif isinstance(spec, LrnSpec):
             x = pk.lrn_pallas(

@@ -90,7 +90,37 @@ def make_parser() -> argparse.ArgumentParser:
         "--deadline-s",
         type=float,
         default=0.0,
-        help="wall-clock budget for build+compile retries (0 = unbounded)",
+        help="wall-clock budget for build+compile retries (0 = unbounded); "
+        "with --tune it also bounds the sweep, which degrades to the "
+        "default plan instead of wedging",
+    )
+    p.add_argument(
+        "--tune",
+        action="store_true",
+        help="autotune the Pallas kernel-variant plan for this geometry/"
+        "dtype/batch and run with it; the plan is cached in --plan (a "
+        "fresh matching entry skips the sweep entirely — docs/TUNING.md)",
+    )
+    p.add_argument(
+        "--tune-force",
+        action="store_true",
+        help="with --tune: re-sweep even when the plan cache has a fresh entry",
+    )
+    p.add_argument(
+        "--tune-repeats", type=int, default=5,
+        help="timed chain length per tuning candidate (amortized_stats n_large - n_small)",
+    )
+    p.add_argument(
+        "--tune-warmup", type=int, default=2,
+        help="warmup chain length per tuning candidate",
+    )
+    p.add_argument(
+        "--plan",
+        default="",
+        help="TunePlan JSON path: with --tune the cache target (default "
+        "perf/tune_plan.json), otherwise load per-layer kernel variants "
+        "from it; explicit TPU_FRAMEWORK_* env knobs still win "
+        "(docs/TUNING.md)",
     )
     return p
 
@@ -172,6 +202,53 @@ def main(argv=None) -> int:
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind} "
           f"({jax.default_backend()})")
 
+    # Kernel-variant tuning plan: --tune sweeps (or loads the cached sweep),
+    # --plan alone loads; either way the resolved plan rides into
+    # build_forward and its hash is printed for the harness CSV. The
+    # "Tune plan:" line is part of the machine-parsed stdout contract
+    # (harness._RE_PLAN).
+    plan = None
+    if args.tune or args.plan:
+        from pathlib import Path
+
+        from .resilience.policy import Deadline as _Deadline
+        from .tuning.autotune import autotune
+        from .tuning.plan import load_plan
+
+        plan_path = args.plan or str(
+            Path(__file__).resolve().parent.parent / "perf" / "tune_plan.json"
+        )
+        device_kind = jax.devices()[0].device_kind
+        if args.tune:
+            plan, cached = autotune(
+                plan_path,
+                model_cfg,
+                dtype=args.compute,
+                batch=args.batch,
+                force=args.tune_force,
+                deadline=_Deadline.after(args.deadline_s or None),
+                repeats=args.tune_repeats,
+                warmup=args.tune_warmup,
+                device_kind=device_kind,
+            )
+            print(
+                f"Tune plan: {'cache' if cached else 'swept'} "
+                f"hash={plan.plan_hash()} key={plan.key} path={plan_path}"
+                + (f" DEGRADED({plan.degraded})" if plan.degraded else "")
+            )
+        else:
+            plan = load_plan(
+                plan_path, device_kind=device_kind, model_cfg=model_cfg,
+                dtype=args.compute, batch=args.batch,
+            )
+            if plan is None:
+                print(
+                    f"Tune plan: none matching in {plan_path} "
+                    "(untuned defaults; run --tune to sweep)"
+                )
+            else:
+                print(f"Tune plan: loaded hash={plan.plan_hash()} key={plan.key}")
+
     if exec_cfg.model == "alexnet_full":
         from .models.alexnet_full import init_full_deterministic, init_full_random
 
@@ -251,7 +328,9 @@ def main(argv=None) -> int:
     def _build_and_compile(key: str):
         cfg = REGISTRY[key]
         _chaos_build_faults(cfg)
-        f = build_forward(cfg, model_cfg, n_shards=args.shards, compute=args.compute)
+        f = build_forward(
+            cfg, model_cfg, n_shards=args.shards, compute=args.compute, plan=plan
+        )
         t0 = time.perf_counter()
         jax.block_until_ready(f(params, x))
         return f, (time.perf_counter() - t0) * 1e3
@@ -265,7 +344,10 @@ def main(argv=None) -> int:
     if not resilient:
         # Historical fast path, byte-identical stdout/stderr.
         try:
-            fwd = build_forward(exec_cfg, model_cfg, n_shards=args.shards, compute=args.compute)
+            fwd = build_forward(
+                exec_cfg, model_cfg, n_shards=args.shards, compute=args.compute,
+                plan=plan,
+            )
         except (ValueError, NotImplementedError, ModuleNotFoundError) as e:
             print(f"cannot build config {exec_cfg.key!r}: {e}", file=sys.stderr)
             return 2

@@ -1,0 +1,39 @@
+"""Kernel autotuning: candidate space, sweep, and persistent TunePlans.
+
+The per-(geometry, dtype, batch, device, code-rev) replacement for hand-set
+TPU_FRAMEWORK_* variant knobs — see docs/TUNING.md. Env precedence: an
+explicit env var beats a tuned plan beats the code default.
+"""
+
+from .autotune import autotune, autotune_model, tune_layer
+from .plan import (
+    TunePlan,
+    VARIANT_ENV,
+    code_rev,
+    effective_layer_variants,
+    explicit_env_knobs,
+    load_plan,
+    plan_key,
+    save_plan,
+    shape_key,
+)
+from .space import ConvGeometry, candidate_space, conv_geometries, prune_reason
+
+__all__ = [
+    "ConvGeometry",
+    "TunePlan",
+    "VARIANT_ENV",
+    "autotune",
+    "autotune_model",
+    "candidate_space",
+    "code_rev",
+    "conv_geometries",
+    "effective_layer_variants",
+    "explicit_env_knobs",
+    "load_plan",
+    "plan_key",
+    "prune_reason",
+    "save_plan",
+    "shape_key",
+    "tune_layer",
+]

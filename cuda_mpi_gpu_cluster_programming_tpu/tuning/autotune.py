@@ -1,0 +1,245 @@
+"""The kernel autotuner: sweep, time, pick, persist.
+
+Per conv layer (the ``_conv_then_pool`` lowering unit) the sweep times every
+legal ``KernelVariants`` candidate with the repo's chained-timing discipline
+(``utils.timing.amortized_stats``: warmup chain, repeat chain, CI95 on the
+median — the same estimator every committed headline uses, so tuned-vs-
+default deltas are apples-to-apples) and persists the winners as a
+``TunePlan`` keyed by (device kind, geometry, batch, dtype, code revision).
+
+Resilience contract (PR-1 layer): the whole sweep runs under a ``Deadline``
+and every candidate is a chaos-injectable ``kernel_compile`` site. A
+candidate that fails to compile/lower is recorded and skipped; a layer whose
+candidates ALL fail, or that the deadline cuts off, degrades to the DEFAULT
+variants — the plan says so in ``degraded`` and per-layer stats, and the
+caller gets a usable plan instead of a wedge.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+from ..ops.pallas_kernels import KernelVariants
+from ..resilience import chaos
+from ..resilience.policy import Deadline
+from .plan import TunePlan, code_rev, load_plan, save_plan, shape_key
+from .space import ConvGeometry, candidate_space, layer_tuning_units
+
+# timer(geometry, variants, dtype, batch, repeats, warmup) -> (ms, ci95, n).
+# Injectable so tier-1 tests sweep deterministically without timing jax.
+Timer = Callable[[ConvGeometry, KernelVariants, str, int, int, int],
+                 Tuple[float, float, int]]
+
+
+def _default_timer(
+    g: ConvGeometry, v: KernelVariants, dtype: str, batch: int,
+    repeats: int, warmup: int,
+) -> Tuple[float, float, int]:
+    """Time one candidate on the real backend via the production lowering
+    path (``_conv_then_pool`` — the same gates the model forward runs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.alexnet import ConvSpec, PoolSpec
+    from ..ops import pallas_kernels as pk
+    from ..ops.pallas_model import _conv_then_pool
+    from ..utils.timing import amortized_stats
+
+    jdt = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    x = jnp.full((batch, g.in_h, g.in_w, g.in_channels), 1.0, jdt)
+    w = jnp.full(
+        (g.filter_size, g.filter_size, g.in_channels, g.out_channels), 0.01, jdt
+    )
+    b = jnp.zeros((g.out_channels,), jdt)
+    cspec = ConvSpec(g.out_channels, g.filter_size, g.stride, g.padding)
+    if g.has_pool:
+        pspec = PoolSpec(g.pool_window, g.pool_stride)
+        fn = jax.jit(lambda x, w, b: _conv_then_pool(x, w, b, cspec, pspec, v))
+    else:
+        fn = jax.jit(
+            functools.partial(
+                pk.conv2d_pallas, stride=g.stride, padding=g.padding, relu=True,
+                variant=v.conv, row_block=v.row_block, k_block=v.k_block,
+            )
+        )
+    n_small = max(1, warmup)
+    st = amortized_stats(
+        fn, x, w, b,
+        n_small=n_small, n_large=n_small + max(1, repeats),
+        min_samples=2, max_samples=4,
+    )
+    return st.per_call_ms, st.ci95_ms, st.n_samples
+
+
+def _interpret_mode() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def tune_layer(
+    g: ConvGeometry,
+    *,
+    dtype: str,
+    batch: int,
+    deadline: Deadline,
+    repeats: int,
+    warmup: int,
+    timer: Timer,
+    log: Callable[[str], None],
+    interpret: Optional[bool] = None,
+) -> Tuple[KernelVariants, dict, str]:
+    """Sweep one layer; returns (winner, stats, degraded_reason)."""
+    interpret = _interpret_mode() if interpret is None else interpret
+    default = KernelVariants().bind(g.out_channels)
+    pruned: list = []
+    cands = candidate_space(
+        g, interpret=interpret, on_prune=lambda v, why: pruned.append(why)
+    )
+    ch = chaos.active()
+    timed: list = []   # (ms, ci95, n, variants)
+    failed: list = []  # (variants, cause)
+    cut = ""
+    for v in cands:
+        if deadline.expired:
+            cut = (
+                f"deadline expired after {len(timed) + len(failed)}/"
+                f"{len(cands)} candidates"
+            )
+            break
+        try:
+            if ch is not None:
+                ch.maybe_raise("kernel_compile", f"tune {g.name} [{v.label()}]")
+            ms, ci, n = timer(g, v, dtype, batch, repeats, warmup)
+            timed.append((ms, ci, n, v))
+            log(f"tune {g.name}: {v.label()} -> {ms:.3f} ms (ci95 {ci:.3f}, n={n})")
+        except Exception as e:  # noqa — a broken candidate must not kill the sweep
+            cause = f"{type(e).__name__}: {e}"[:120]
+            failed.append((v, cause))
+            log(f"tune {g.name}: {v.label()} FAILED ({cause})")
+    stats = {
+        "geometry": g.describe(),
+        "candidates": len(cands),
+        "pruned": len(pruned),
+        "timed": len(timed),
+        "failed": len(failed),
+    }
+    if not timed:
+        reason = cut or (
+            f"all {len(cands)} candidates failed" if failed else "no legal candidates"
+        )
+        stats["degraded"] = reason
+        log(f"tune {g.name}: DEGRADED to defaults ({reason})")
+        return default, stats, reason
+    best_ms, best_ci, best_n, winner = min(timed, key=lambda t: t[0])
+    stats.update(
+        best_ms=round(best_ms, 4), best_ci95_ms=round(best_ci, 4), best_n=best_n
+    )
+    # The default lowering's time, for the tuned-vs-default story — matched
+    # by effective signature (rb=64 and rb=32 can be the same lowering on a
+    # 27-row image; either row is THE default's measurement).
+    from .space import _effective_signature
+
+    dsig = _effective_signature(default, g)
+    for ms, _ci, _n, v in timed:
+        if _effective_signature(v, g) == dsig:
+            stats["default_ms"] = round(ms, 4)
+            break
+    if cut:
+        stats["degraded"] = cut  # partial sweep: winner stands, but say so
+    log(
+        f"tune {g.name}: winner {winner.label()} at {best_ms:.3f} ms"
+        + (f" (default {stats['default_ms']:.3f} ms)" if "default_ms" in stats else "")
+    )
+    return winner, stats, cut
+
+
+def autotune_model(
+    model_cfg,
+    *,
+    dtype: str,
+    batch: int,
+    deadline: Optional[Deadline] = None,
+    repeats: int = 5,
+    warmup: int = 2,
+    timer: Optional[Timer] = None,
+    log: Callable[[str], None] = print,
+    device_kind: Optional[str] = None,
+) -> TunePlan:
+    """Sweep every conv layer of ``model_cfg`` and return the TunePlan."""
+    deadline = deadline or Deadline.after(None)
+    timer = timer or _default_timer
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    layers: list = []
+    stats: dict = {}
+    notes: list = []
+    for name, g in layer_tuning_units(model_cfg):
+        if deadline.expired:
+            # Degrade, don't wedge: remaining layers get the defaults and the
+            # plan says which and why.
+            layers.append((name, KernelVariants().bind(g.out_channels)))
+            stats[name] = {
+                "geometry": g.describe(),
+                "degraded": "deadline expired before sweep",
+            }
+            notes.append(f"{name}: deadline expired before sweep")
+            continue
+        winner, lstats, degraded = tune_layer(
+            g, dtype=dtype, batch=batch, deadline=deadline,
+            repeats=repeats, warmup=warmup, timer=timer, log=log,
+        )
+        layers.append((name, winner))
+        stats[name] = lstats
+        if degraded:
+            notes.append(f"{name}: {degraded}")
+    return TunePlan(
+        device_kind=device_kind,
+        shape_key=shape_key(model_cfg),
+        batch=batch,
+        dtype=dtype,
+        code_rev=code_rev(),
+        layers=tuple(layers),
+        stats=stats,
+        degraded="; ".join(notes),
+    )
+
+
+def autotune(
+    path,
+    model_cfg,
+    *,
+    dtype: str,
+    batch: int,
+    force: bool = False,
+    deadline: Optional[Deadline] = None,
+    repeats: int = 5,
+    warmup: int = 2,
+    timer: Optional[Timer] = None,
+    log: Callable[[str], None] = print,
+    device_kind: Optional[str] = None,
+) -> Tuple[TunePlan, bool]:
+    """Cached sweep: a fresh on-disk plan for this exact point (same device,
+    geometry, batch, dtype, code revision) short-circuits the whole sweep —
+    ``(plan, True)``. Otherwise sweep, persist, ``(plan, False)``."""
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    if not force:
+        cached = load_plan(
+            path, device_kind=device_kind, model_cfg=model_cfg,
+            dtype=dtype, batch=batch, match_any_batch=False,
+        )
+        if cached is not None:
+            return cached, True
+    plan = autotune_model(
+        model_cfg, dtype=dtype, batch=batch, deadline=deadline,
+        repeats=repeats, warmup=warmup, timer=timer, log=log,
+        device_kind=device_kind,
+    )
+    save_plan(plan, path)
+    return plan, False

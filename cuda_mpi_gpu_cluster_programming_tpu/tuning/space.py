@@ -1,0 +1,182 @@
+"""Legal candidate space of the kernel autotuner.
+
+One conv layer (plus the pool it feeds, when adjacent) is the tuning unit —
+exactly the granularity ``ops.pallas_model._conv_then_pool`` lowers at. The
+space is the cartesian product of every ``KernelVariants`` knob, PRUNED to
+combinations that can actually lower and DEDUPED to distinct effective
+lowerings, so the sweep never spends timing budget on a candidate that
+``conv2d_pallas`` would reject (hardware k_block lane rule), silently
+degrade (geometry-dropped k_block — the mislabeled-A/B-row hazard
+``_warn_k_block_dropped`` guards), or alias (row blocks beyond the output
+height all clamp to whole-image programs).
+
+Every prune is attributable: ``prune_reason`` returns WHY a combo is out,
+and ``candidate_space`` can report each drop to a logger — no silent caps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, List, Optional, Tuple
+
+from ..ops.pallas_kernels import KernelVariants
+
+# Knob domains — mirror the env_variant allowed-sets in ops.pallas_kernels
+# (the tuner must not invent values the env interface refuses).
+CONV_VARIANTS = ("taps", "pairs", "fused", "vcol", "g8")
+POOL_VARIANTS = ("sep2", "phases")
+ROW_BLOCKS = (8, 16, 32, 64)
+K_BLOCKS = (0, 64, 128)
+FUSES = ("none", "hpool")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvGeometry:
+    """One conv layer's tuning-relevant geometry (+ its trailing pool)."""
+
+    name: str
+    filter_size: int
+    stride: int
+    padding: int
+    in_channels: int
+    out_channels: int
+    in_h: int
+    in_w: int
+    pool_window: int = 0  # 0 = no adjacent pool
+    pool_stride: int = 0
+
+    @property
+    def out_h(self) -> int:
+        return (self.in_h - self.filter_size + 2 * self.padding) // self.stride + 1
+
+    @property
+    def fq(self) -> int:
+        return -(-self.filter_size // self.stride)  # ceil(F/S): taps per axis
+
+    @property
+    def has_pool(self) -> bool:
+        return self.pool_window > 0
+
+    def describe(self) -> str:
+        pool = f" pool={self.pool_window}/{self.pool_stride}" if self.has_pool else ""
+        return (
+            f"{self.name}: {self.filter_size}x{self.filter_size}s{self.stride}"
+            f"p{self.padding} K={self.out_channels} "
+            f"in={self.in_h}x{self.in_w}x{self.in_channels}{pool}"
+        )
+
+
+def conv_geometries(model_cfg) -> List[ConvGeometry]:
+    """The model's conv layers with their input dims and trailing pools —
+    driven by the shared ``models.alexnet.layer_dims`` traversal, so tuned
+    geometry cannot drift from the FLOP/shape accounting."""
+    from ..models.alexnet import ConvSpec, PoolSpec, layer_dims
+
+    chain = list(layer_dims(model_cfg))
+    out: List[ConvGeometry] = []
+    for i, (name, spec, (hi, wi, ci), _o) in enumerate(chain):
+        if not isinstance(spec, ConvSpec):
+            continue
+        pw = ps = 0
+        if i + 1 < len(chain) and isinstance(chain[i + 1][1], PoolSpec):
+            nxt = chain[i + 1][1]
+            pw, ps = nxt.window, nxt.stride
+        out.append(
+            ConvGeometry(
+                name=name,
+                filter_size=spec.filter_size,
+                stride=spec.stride,
+                padding=spec.padding,
+                in_channels=ci,
+                out_channels=spec.out_channels,
+                in_h=hi,
+                in_w=wi,
+                pool_window=pw,
+                pool_stride=ps,
+            )
+        )
+    return out
+
+
+def prune_reason(v: KernelVariants, g: ConvGeometry, *, interpret: bool) -> str:
+    """Why this combo is out of the sweep ('' = legal). Mirrors the gates in
+    _conv2d_pallas / _conv_then_pool — a candidate this accepts must lower
+    and run the variant it claims."""
+    if v.conv == "pairs" and g.fq < 2:
+        return f"pairs degenerates to taps at fq={g.fq} (nothing to pair)"
+    if v.conv == "g8" and g.stride < 2:
+        return "g8 falls back to vcol at stride 1 (phase packing needs s>=2)"
+    if v.k_block:
+        if v.conv not in ("taps", "vcol"):
+            return f"k_block applies to the taps/vcol path only (conv={v.conv})"
+        if v.k_block % 128 != 0 and not interpret:
+            return f"k_block={v.k_block} cannot lower on hardware (lane tiling 128)"
+        if not (g.out_channels % v.k_block == 0 and g.out_channels > v.k_block):
+            return (
+                f"k_block={v.k_block} dropped at K={g.out_channels} "
+                "(runs unblocked — duplicate of kb=0)"
+            )
+    if v.fuse == "hpool":
+        if not g.has_pool:
+            return "hpool fusion needs an adjacent pool"
+        if v.conv not in ("taps", "vcol"):
+            return f"hpool fusion supports taps/vcol only (conv={v.conv})"
+        if v.pool != "sep2":
+            return "hpool fusion is the sep2 pool's H stage (pool=phases excluded)"
+        if v.row_block < g.out_h:
+            return (
+                f"hpool fusion needs the whole image per program "
+                f"(row_block {v.row_block} < ho {g.out_h})"
+            )
+        if v.k_block:
+            return "hpool fusion does not compose with k_block"
+    return ""
+
+
+def _effective_signature(v: KernelVariants, g: ConvGeometry) -> tuple:
+    """What actually lowers: row blocks clamp to the output height, and the
+    pool knob is moot without an adjacent pool."""
+    return (
+        v.conv,
+        v.pool if g.has_pool else "-",
+        min(v.row_block, g.out_h),
+        v.k_block,
+        v.fuse,
+    )
+
+
+def candidate_space(
+    g: ConvGeometry,
+    *,
+    interpret: bool,
+    on_prune: Optional[Callable[[KernelVariants, str], None]] = None,
+) -> List[KernelVariants]:
+    """Every legal, effectively-distinct candidate for this layer, each
+    bound to the layer's K so logs/plans are self-labeling."""
+    seen: set = set()
+    out: List[KernelVariants] = []
+    for conv, pool, rb, kb, fuse in itertools.product(
+        CONV_VARIANTS, POOL_VARIANTS, ROW_BLOCKS, K_BLOCKS, FUSES
+    ):
+        v = KernelVariants(
+            conv=conv, pool=pool, row_block=rb, k_block=kb, fuse=fuse,
+            k_channels=g.out_channels,
+        )
+        why = prune_reason(v, g, interpret=interpret)
+        if not why:
+            sig = _effective_signature(v, g)
+            if sig in seen:
+                why = f"duplicate effective lowering {sig}"
+            else:
+                seen.add(sig)
+                out.append(v)
+                continue
+        if on_prune is not None:
+            on_prune(v, why)
+    return out
+
+
+def layer_tuning_units(model_cfg) -> List[Tuple[str, ConvGeometry]]:
+    """(layer_name, geometry) pairs in chain order — the sweep's work list."""
+    return [(g.name, g) for g in conv_geometries(model_cfg)]

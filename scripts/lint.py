@@ -20,6 +20,15 @@ bitten this codebase plus the usual hygiene set:
                   fail-open one-shot execution that ate four rounds of
                   bench evidence. A deliberate bounded call site is
                   annotated ``# noqa: raw-subprocess``.
+  variant-env   — direct ``os.environ``/``os.getenv`` READS of the Pallas
+                  kernel-variant knobs (TPU_FRAMEWORK_CONV/_POOL/_ROWBLOCK/
+                  _KBLOCK/_FUSE/_CHAIN, and any PALLAS_* knob) outside
+                  ``tuning/`` and ``ops/pallas_kernels.py``: the tuned-plan
+                  precedence chain (explicit env > TunePlan > default,
+                  docs/TUNING.md) has ONE implementation — a stray read
+                  forks it and resurrects the process-global-variant
+                  footgun. Annotate a deliberate read
+                  ``# noqa: variant-env``.
   tabs / trailing-ws / long-lines(>120) — formatting conventions.
 
 Run: ``python scripts/lint.py [paths...]`` — exit 0 clean, 1 findings.
@@ -59,6 +68,38 @@ def _raw_subprocess_scoped(path: Path) -> bool:
     return any(part in _RAW_SUBPROCESS_DIRS for part in path.parts)
 
 
+# Kernel-variant env knobs whose direct reads are confined to tuning/ and
+# ops/pallas_kernels.py (env_variant / KernelVariants.resolve) — keep in
+# sync with tuning.plan.VARIANT_ENV plus the chain knob.
+_VARIANT_KNOBS = {
+    "TPU_FRAMEWORK_CONV",
+    "TPU_FRAMEWORK_POOL",
+    "TPU_FRAMEWORK_ROWBLOCK",
+    "TPU_FRAMEWORK_KBLOCK",
+    "TPU_FRAMEWORK_FUSE",
+    "TPU_FRAMEWORK_CHAIN",
+}
+_VARIANT_KNOB_PREFIXES = ("PALLAS_",)
+
+
+def _is_variant_knob(name: str) -> bool:
+    return name in _VARIANT_KNOBS or name.startswith(_VARIANT_KNOB_PREFIXES)
+
+
+def _variant_env_scoped(path: Path) -> bool:
+    """True = direct variant-knob env reads are forbidden here."""
+    return "tuning" not in path.parts and path.name != "pallas_kernels.py"
+
+
+def _is_os_environ(node: ast.expr) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
 def _noqa_lines(src: str) -> dict:
     """line -> set of suppressed codes ('*' = all)."""
     out = {}
@@ -80,6 +121,7 @@ class _Checker(ast.NodeVisitor):
         self.used: set = set()
         self.src = src
         self.check_raw_subprocess = _raw_subprocess_scoped(path)
+        self.check_variant_env = _variant_env_scoped(path)
 
     # --- imports ---
     def visit_Import(self, node: ast.Import) -> None:
@@ -124,7 +166,48 @@ class _Checker(ast.NodeVisitor):
                  "(use parallel.deploy._transport_run or a bounded wrapper; "
                  "annotate deliberate call sites with # noqa: raw-subprocess)")
             )
+        # os.environ.get("TPU_FRAMEWORK_CONV") / os.getenv(...) of a variant
+        # knob outside the sanctioned readers.
+        if self.check_variant_env:
+            knob = None
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "get"
+                and _is_os_environ(f.value)
+            ) or (
+                isinstance(f, ast.Attribute)
+                and f.attr == "getenv"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "os"
+            ):
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    knob = node.args[0].value
+            if isinstance(knob, str) and _is_variant_knob(knob):
+                self._variant_env_finding(node.lineno, knob)
         self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # os.environ["TPU_FRAMEWORK_..."] reads (stores are fine — tests and
+        # harnesses legitimately SET knobs; only reads fork the precedence).
+        if (
+            self.check_variant_env
+            and isinstance(node.ctx, ast.Load)
+            and _is_os_environ(node.value)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+            and _is_variant_knob(node.slice.value)
+        ):
+            self._variant_env_finding(node.lineno, node.slice.value)
+        self.generic_visit(node)
+
+    def _variant_env_finding(self, lineno: int, knob: str) -> None:
+        self.findings.append(
+            (self.path, lineno, "variant-env",
+             f"direct read of variant knob {knob!r} outside tuning// "
+             "pallas_kernels.py forks the env > TunePlan > default "
+             "precedence (route through KernelVariants.resolve or "
+             "tuning.plan; deliberate reads: # noqa: variant-env)")
+        )
 
     # --- bare except ---
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:

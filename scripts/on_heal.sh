@@ -107,6 +107,28 @@ fi
 
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
 
+say "kernel autotune + tuned headline (plan cached in perf/tune_plan.json; docs/TUNING.md)"
+# The sweep runs ONCE per (dtype, batch, code-rev) point — later heal
+# windows hit the plan cache and go straight to the tuned measurement.
+# --deadline-s bounds the sweep: expiry degrades to the default plan
+# (visibly) instead of eating the window.
+for comp in bf16 fp32; do
+    timeout 2400 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+        --config v3_pallas --batch 128 --compute $comp --repeats 100 \
+        --tune --plan perf/tune_plan.json --deadline-s 1800 2>&1 \
+        | grep -E "Tune plan|completed in|DEGRADED" \
+        | sed "s/^/tuned $comp /" | tee -a "$LOG"
+done
+# Tuned-vs-default bench rows (one JSON row per config, each carrying
+# plan_hash + both per_pass_ms) — the adoption evidence. Commit the
+# .jsonl together with perf/tune_plan.json (rows are unattributable
+# without their plan).
+BENCH_PLAN=perf/tune_plan.json BENCH_CONFIGS=v1_jit,v3_pallas BENCH_BF16=0 \
+    timeout 2400 python bench.py 2>>"$LOG" \
+    | grep '^{' > perf/bench_tuned_${FTS}.jsonl \
+    || say "tuned bench failed — see $LOG"
+[ -s perf/bench_tuned_${FTS}.jsonl ] && tee -a "$LOG" < perf/bench_tuned_${FTS}.jsonl
+
 say "g8 phase-packed conv: first-ever Mosaic lowering + correctness on chip, then the adoption A/B (round-5 named lever, coded blind against a wedged chip)"
 if timeout 600 python - >>"$LOG" 2>&1 <<'EOF'
 import jax, numpy as np, jax.numpy as jnp

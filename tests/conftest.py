@@ -26,3 +26,11 @@ jax.config.update("jax_enable_x64", False)
 assert jax.device_count() == 8, (
     f"tests require the virtual 8-device CPU mesh, got {jax.devices()}"
 )
+
+
+def pytest_configure(config):
+    # Tier-1 runs with -m 'not slow' (ROADMAP); register the marker so the
+    # opt-in heavyweight tests (real-timing tuner CLI sweep) don't warn.
+    config.addinivalue_line(
+        "markers", "slow: heavyweight test excluded from the tier-1 sweep"
+    )

@@ -131,3 +131,38 @@ def test_bench_end_to_end_cpu_schema():
     # (the sub-object is a TPU-capability statement).
     assert out["mfu"] is None
     assert "bf16" not in out
+
+
+def test_bench_multi_config_sweep_one_row_per_config():
+    """BENCH_CONFIGS: one parseable JSON row PER config (the V1->V5 story
+    measured), each with the standard schema and its own config key."""
+    env = dict(os.environ)
+    env.update(
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        BENCH_CONFIGS="v1_jit,v3_pallas",
+        BENCH_BATCH="2",
+        BENCH_REPEATS="2",
+        BENCH_TIMEOUT="600",
+    )
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=600, cwd=ROOT, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    rows = [json.loads(l) for l in res.stdout.splitlines() if l.startswith("{")]
+    assert [r["config"] for r in rows] == ["v1_jit", "v3_pallas"]
+    for r in rows:
+        assert r["metric"] == bench.METRIC
+        assert r["value"] > 0 and r["batch"] == 2
+        assert r["timing_n"] >= 1
+
+
+def test_error_rows_carry_their_config(tmp_path, monkeypatch):
+    """Multi-config error paths label every row; _error_obj defaults to the
+    single-config contract otherwise."""
+    fake_root = tmp_path / "repo"
+    (fake_root / "perf").mkdir(parents=True)
+    monkeypatch.setattr(bench, "ROOT", str(fake_root))
+    assert json.loads(bench._error_json("down"))["config"] == bench.CONFIG
+    assert bench._error_obj("down", config="v3_pallas")["config"] == "v3_pallas"

@@ -110,6 +110,25 @@ def test_parse_run_log_full():
     assert r.status == harness.OK
 
 
+def test_plan_hash_parsed_into_csv_row(tmp_path):
+    """The run CLI's 'Tune plan:' line lands in the PlanHash CSV column, so
+    tuned rows are attributable to one exact plan (docs/TUNING.md)."""
+    for verb in ("swept", "cache", "loaded"):
+        m = harness._RE_PLAN.search(
+            f"Devices: 1 x cpu (cpu)\nTune plan: {verb} hash=0efe8300ae "
+            "key=cpu|blocks12_227x227x3|b1|fp32|rev=abc path=perf/tune_plan.json\n"
+        )
+        assert m and m.group(1) == "0efe8300ae", verb
+    session = harness.Session(log_root=tmp_path)
+    r = harness.CaseResult("V3 CUDA", "v3_pallas", 1, 1)
+    r.run_status = harness.OK
+    r.plan_hash = "0efe8300ae"
+    session.log_row(r)
+    with open(session.csv_path) as f:
+        rows = list(csv.reader(f))
+    assert rows[1][rows[0].index("PlanHash")] == "0efe8300ae"
+
+
 def test_parse_run_log_missing_fields_degrade_to_parse_err():
     # Missing fields → ⚠ Parse Error, not failure (common_test_utils.sh:319-324).
     r = harness.CaseResult("V1 Serial", "v1_jit", 1, 1)
@@ -141,12 +160,14 @@ def test_session_csv_schema(tmp_path):
         rows = list(csv.reader(f))
     assert rows[0] == harness.CSV_COLUMNS
     # The reference's 20-column schema + the 2 resilience attempt-metadata
-    # columns (appended, so historical column indexes are untouched).
-    assert len(rows[0]) == 22
-    assert rows[0][20:] == ["Attempts", "ResilienceMsg"]
+    # columns + the tuning PlanHash column (each appended, so historical
+    # column indexes are untouched).
+    assert len(rows[0]) == 23
+    assert rows[0][20:] == ["Attempts", "ResilienceMsg", "PlanHash"]
     assert rows[1][4] == "V1 Serial"
     assert rows[1][14] == harness.OK
     assert rows[1][20] == "1"  # single attempt, no retries
+    assert rows[1][22] == ""  # untuned row: no plan hash
 
 
 def test_run_case_subprocess_sweep(tmp_path):

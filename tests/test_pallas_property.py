@@ -45,7 +45,7 @@ def test_conv_matches_reference(h, w_dim, c, k, f, stride, padding, relu, varian
     assume(h + 2 * padding >= f and w_dim + 2 * padding >= f)
     # Plain env set/restore per example (hypothesis rejects function-scoped
     # fixtures; the variant env is read at trace time of the direct call).
-    saved = os.environ.get("TPU_FRAMEWORK_CONV")
+    saved = os.environ.get("TPU_FRAMEWORK_CONV")  # noqa: variant-env
     os.environ["TPU_FRAMEWORK_CONV"] = variant
     try:
         _check_conv(h, w_dim, c, k, f, stride, padding, relu)

@@ -1,0 +1,391 @@
+"""Autotuner tier-1 tests (CPU interpret mode): candidate-space pruning,
+plan cache round-trip, stale-key invalidation, resilience degradation
+(Deadline abort / chaos compile faults -> default plan), env precedence,
+and per-layer variant threading through the Pallas forward.
+
+The sweep itself is exercised with an injected deterministic timer (the
+real amortized-timing path is covered by the run CLI --tune test and the
+production timing suite) so these stay fast and order-stable.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import (
+    BLOCKS12,
+    Blocks12Config,
+    flops_per_image,
+    layer_dims,
+    matmul_flops_per_image,
+    output_shape,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.ops import pallas_kernels as pk
+from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import Deadline
+from cuda_mpi_gpu_cluster_programming_tpu.tuning import plan as tp
+from cuda_mpi_gpu_cluster_programming_tpu.tuning import space as ts
+from cuda_mpi_gpu_cluster_programming_tpu.tuning.autotune import (
+    autotune,
+    autotune_model,
+)
+
+SMALL = Blocks12Config(in_height=43, in_width=43)
+
+
+def geometries(cfg=BLOCKS12):
+    return {g.name: g for g in ts.conv_geometries(cfg)}
+
+
+# ---------------------------------------------------------------- space ---
+
+
+def test_shared_traversal_matches_committed_dims():
+    """layer_dims is the one shape walk: output_shape and the FLOP counters
+    must keep their committed default-config values on top of it."""
+    assert output_shape() == (13, 13, 256)
+    assert flops_per_image() == 1108641024
+    assert matmul_flops_per_image() == 1106625600
+    names = [n for n, *_ in layer_dims(BLOCKS12)]
+    assert names == ["conv1", "pool1", "conv2", "pool2", "lrn2"]
+
+
+def test_conv_geometries_carry_trailing_pools():
+    gs = geometries()
+    assert set(gs) == {"conv1", "conv2"}
+    g1, g2 = gs["conv1"], gs["conv2"]
+    assert (g1.in_h, g1.in_w, g1.in_channels, g1.out_channels) == (227, 227, 3, 96)
+    assert g1.out_h == 55 and g1.pool_window == 3 and g1.pool_stride == 2
+    assert (g2.in_h, g2.in_w, g2.in_channels, g2.out_channels) == (27, 27, 96, 256)
+    assert g2.out_h == 27
+
+
+def test_space_prunes_geometry_dropped_k_block():
+    """conv1's K=96 divides by neither 64 nor 128 -> every k_block candidate
+    would run unblocked (the mislabeled-A/B hazard); none may survive."""
+    g1 = geometries()["conv1"]
+    cands = ts.candidate_space(g1, interpret=True)
+    assert cands and all(v.k_block == 0 for v in cands)
+    # conv2's K=256 admits both on interpret mode; hardware refuses 64
+    # (lane tiling 128) rather than silently dropping it.
+    g2 = geometries()["conv2"]
+    kbs_interp = {v.k_block for v in ts.candidate_space(g2, interpret=True)}
+    assert kbs_interp == {0, 64, 128}
+    kbs_hw = {v.k_block for v in ts.candidate_space(g2, interpret=False)}
+    assert kbs_hw == {0, 128}
+
+
+def test_space_prunes_variant_geometry_mismatches():
+    gs = geometries()
+    c2 = ts.candidate_space(gs["conv2"], interpret=True)
+    assert all(v.conv != "g8" for v in c2)  # stride 1: g8 falls back to vcol
+    c1 = ts.candidate_space(gs["conv1"], interpret=True)
+    assert any(v.conv == "g8" for v in c1)  # stride 4: g8 is a real candidate
+    # hpool candidates obey the production gate exactly.
+    for cands, g in ((c1, gs["conv1"]), (c2, gs["conv2"])):
+        for v in cands:
+            if v.fuse == "hpool":
+                assert v.conv in ("taps", "vcol") and v.pool == "sep2"
+                assert v.row_block >= g.out_h and v.k_block == 0
+
+
+def test_space_dedupes_clamped_row_blocks_and_reports_prunes():
+    """Row blocks past the output height all clamp to whole-image programs —
+    only one such candidate may survive — and every drop is reported."""
+    g2 = geometries()["conv2"]  # out_h = 27: rb 32 and 64 alias
+    dropped = []
+    cands = ts.candidate_space(
+        g2, interpret=True, on_prune=lambda v, why: dropped.append(why)
+    )
+    taps_plain = [
+        v.row_block for v in cands
+        if (v.conv, v.pool, v.k_block, v.fuse) == ("taps", "sep2", 0, "none")
+    ]
+    assert sorted(taps_plain) in ([8, 16, 32], [8, 16, 64])
+    assert dropped and any("duplicate effective lowering" in w for w in dropped)
+
+
+def test_variants_repr_states_requested_vs_effective_k_block():
+    v = pk.KernelVariants(conv="taps", k_block=128).bind(96)
+    assert v.effective_k_block == 0
+    assert "kb=128->0(K=96)" in repr(v)
+    ok = pk.KernelVariants(conv="taps", k_block=128).bind(256)
+    assert ok.effective_k_block == 128
+    assert "kb=128 " in ok.label() + " "
+    # Unbound variants can't judge geometry: requested value stands.
+    assert pk.KernelVariants(k_block=64).effective_k_block == 64
+    assert v.knobs() == pk.KernelVariants(conv="taps", k_block=128)
+
+
+# ----------------------------------------------------------------- plan ---
+
+
+def fake_timer(table=None):
+    """Deterministic injected timer; optionally scripted per (layer, label)."""
+    calls = []
+
+    def timer(g, v, dtype, batch, repeats, warmup):
+        calls.append((g.name, v))
+        if table is not None:
+            return table(g, v), 0.01, 3
+        # Stable, distinct: favor vcol/sep2/none deterministically.
+        ms = 10.0
+        ms -= 3.0 * (v.conv == "vcol")
+        ms -= 1.0 * (v.pool == "sep2")
+        ms -= 0.5 * (v.fuse == "none")
+        ms -= 0.1 * v.row_block / 64.0
+        return ms, 0.01, 3
+
+    timer.calls = calls
+    return timer
+
+
+def test_autotune_cache_round_trip(tmp_path):
+    path = tmp_path / "plan.json"
+    timer = fake_timer()
+    plan, cached = autotune(
+        path, SMALL, dtype="fp32", batch=2, timer=timer, log=lambda s: None,
+        device_kind="cpu",
+    )
+    assert not cached and timer.calls and not plan.degraded
+    assert [n for n, _ in plan.layers] == ["conv1", "conv2"]
+    for _n, v in plan.layers:
+        assert v.conv == "vcol" and v.pool == "sep2"  # the scripted winner
+    obj = json.loads(path.read_text())
+    assert plan.key in obj["plans"]
+    # Second call: loaded from disk, NO sweep (the acceptance criterion).
+    timer2 = fake_timer()
+    plan2, cached2 = autotune(
+        path, SMALL, dtype="fp32", batch=2, timer=timer2, log=lambda s: None,
+        device_kind="cpu",
+    )
+    assert cached2 and not timer2.calls
+    assert plan2.plan_hash() == plan.plan_hash()
+    assert plan2.layers == plan.layers
+
+
+def test_plan_key_misses_do_not_cross_points(tmp_path):
+    path = tmp_path / "plan.json"
+    plan, _ = autotune(
+        path, SMALL, dtype="fp32", batch=2, timer=fake_timer(),
+        log=lambda s: None, device_kind="cpu",
+    )
+    # Different dtype / device / geometry are all misses.
+    assert tp.load_plan(path, device_kind="cpu", model_cfg=SMALL,
+                        dtype="bf16", batch=2) is None
+    assert tp.load_plan(path, device_kind="TPU v5 lite", model_cfg=SMALL,
+                        dtype="fp32", batch=2) is None
+    assert tp.load_plan(path, device_kind="cpu", model_cfg=BLOCKS12,
+                        dtype="fp32", batch=2) is None
+    # A different batch at the same point is the nearest usable plan
+    # (opt-out via match_any_batch=False, which autotune's cache check uses).
+    near = tp.load_plan(path, device_kind="cpu", model_cfg=SMALL,
+                        dtype="fp32", batch=64)
+    assert near is not None and near.batch == 2
+    assert tp.load_plan(path, device_kind="cpu", model_cfg=SMALL,
+                        dtype="fp32", batch=64, match_any_batch=False) is None
+
+
+def test_stale_code_rev_invalidates(tmp_path):
+    """A plan tuned against different kernel sources is a MISS — stale
+    winners must never apply to changed code."""
+    path = tmp_path / "plan.json"
+    plan, _ = autotune(
+        path, SMALL, dtype="fp32", batch=2, timer=fake_timer(),
+        log=lambda s: None, device_kind="cpu",
+    )
+    obj = json.loads(path.read_text())
+    (key,) = obj["plans"]
+    stale_key = key.replace(f"rev={plan.code_rev}", "rev=deadbeefdead")
+    obj["plans"][stale_key] = {
+        **obj["plans"].pop(key), "code_rev": "deadbeefdead",
+    }
+    path.write_text(json.dumps(obj))
+    assert tp.load_plan(path, device_kind="cpu", model_cfg=SMALL,
+                        dtype="fp32", batch=2) is None
+    # And autotune re-sweeps over it rather than reusing.
+    timer = fake_timer()
+    _plan, cached = autotune(
+        path, SMALL, dtype="fp32", batch=2, timer=timer, log=lambda s: None,
+        device_kind="cpu",
+    )
+    assert not cached and timer.calls
+
+
+def test_deadline_abort_falls_back_to_default_plan(tmp_path):
+    """An already-expired Deadline must yield a usable DEFAULT plan, marked
+    degraded — never a wedge, never a half-silent fallback."""
+    timer = fake_timer()
+    plan = autotune_model(
+        SMALL, dtype="fp32", batch=2, deadline=Deadline.after(1e-9),
+        timer=timer, log=lambda s: None, device_kind="cpu",
+    )
+    assert not timer.calls
+    assert plan.degraded and "deadline" in plan.degraded
+    default = pk.KernelVariants()
+    for name, v in plan.layers:
+        assert v.knobs() == default, (name, v)
+        assert "degraded" in plan.stats[name]
+
+
+def test_chaos_compile_faults_degrade_not_wedge(tmp_path, monkeypatch):
+    """kernel_compile chaos: a transiently-failing candidate is skipped; a
+    layer whose candidates ALL fail degrades to the defaults."""
+    monkeypatch.setenv("CHAOS_SPEC", "kernel_compile=2")
+    chaos.reset()
+    try:
+        timer = fake_timer()
+        plan = autotune_model(
+            SMALL, dtype="fp32", batch=2, timer=timer, log=lambda s: None,
+            device_kind="cpu",
+        )
+        # Two injected faults burned, sweep healed: winners still tuned.
+        assert not plan.degraded
+        assert plan.stats["conv1"]["failed"] == 2
+        assert dict(plan.layers)["conv1"].conv == "vcol"
+
+        monkeypatch.setenv("CHAOS_SPEC", "kernel_compile=100000")
+        chaos.reset()
+        plan2 = autotune_model(
+            SMALL, dtype="fp32", batch=2, timer=fake_timer(),
+            log=lambda s: None, device_kind="cpu",
+        )
+        assert "all" in plan2.degraded and "failed" in plan2.degraded
+        for _n, v in plan2.layers:
+            assert v.knobs() == pk.KernelVariants()
+    finally:
+        chaos.reset()
+
+
+def test_env_precedence_explicit_env_beats_plan(tmp_path, monkeypatch):
+    """Explicit env knob > tuned plan > default — per knob, not whole-set."""
+    plan = autotune_model(
+        SMALL, dtype="fp32", batch=2,
+        timer=fake_timer(lambda g, v: 1.0 if (v.conv, v.row_block) == ("taps", 16) else 5.0),
+        log=lambda s: None, device_kind="cpu",
+    )
+    assert dict(plan.layers)["conv1"].conv == "taps"
+    for var in ("TPU_FRAMEWORK_CONV", "TPU_FRAMEWORK_POOL", "TPU_FRAMEWORK_ROWBLOCK",
+                "TPU_FRAMEWORK_KBLOCK", "TPU_FRAMEWORK_FUSE"):
+        monkeypatch.delenv(var, raising=False)
+    # No env: plan wins every knob.
+    lv = tp.effective_layer_variants(plan)
+    assert lv.for_layer("conv1").conv == "taps"
+    assert lv.for_layer("conv1").row_block == 16
+    # Explicit env pins ITS knob on every layer; the plan keeps the rest.
+    monkeypatch.setenv("TPU_FRAMEWORK_CONV", "fused")
+    lv2 = tp.effective_layer_variants(plan)
+    assert lv2.for_layer("conv1").conv == "fused"
+    assert lv2.for_layer("conv1").row_block == 16  # still the tuned value
+    # Unknown layers fall back to the env-resolved base whole.
+    assert lv2.for_layer("conv9").conv == "fused"
+
+
+# ------------------------------------------------------------ threading ---
+
+
+def test_layer_variants_thread_through_forward():
+    """A per-layer plan (different variants per conv) must produce the same
+    numbers as the global-variant forward — allclose across lowering
+    variants, same contract as the variant A/B tests."""
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_model import (
+        forward_blocks12_pallas,
+    )
+
+    params = init_params_deterministic(SMALL)
+    x = deterministic_input(2, SMALL)
+    base = np.asarray(
+        forward_blocks12_pallas(params, x, SMALL, variants=pk.KernelVariants())
+    )
+    lv = pk.LayerVariants(
+        layers=(
+            ("conv1", pk.KernelVariants(conv="taps", row_block=16).bind(96)),
+            ("conv2", pk.KernelVariants(conv="vcol", fuse="hpool").bind(256)),
+        ),
+        default=pk.KernelVariants(),
+    )
+    got = np.asarray(forward_blocks12_pallas(params, x, SMALL, variants=lv))
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-5)
+
+
+def test_build_forward_applies_plan(tmp_path):
+    """configs.build_forward(plan=...) runs the tuned per-layer variants and
+    matches the untuned forward numerically."""
+    from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+
+    plan = autotune_model(
+        SMALL, dtype="fp32", batch=2,
+        timer=fake_timer(lambda g, v: 1.0 if v.conv == "taps" else 5.0),
+        log=lambda s: None, device_kind="cpu",
+    )
+    assert all(v.conv == "taps" for _n, v in plan.layers)
+    params = init_params_deterministic(SMALL)
+    x = deterministic_input(2, SMALL)
+    untuned = build_forward(REGISTRY["v3_pallas"], SMALL)(params, x)
+    tuned = build_forward(REGISTRY["v3_pallas"], SMALL, plan=plan)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(tuned), np.asarray(untuned), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_build_forward_donate_smoke():
+    """donate=True builds and computes (donation is advisory on CPU; the
+    wiring must not change results for a single call)."""
+    import warnings
+
+    from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY, build_forward
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+
+    params = init_params_deterministic(SMALL)
+    ref = build_forward(REGISTRY["v1_jit"], SMALL)(params, deterministic_input(1, SMALL))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # CPU: "donation is not implemented"
+        out = build_forward(REGISTRY["v1_jit"], SMALL, donate=True)(
+            params, deterministic_input(1, SMALL)
+        )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ------------------------------------------------------------------ CLI ---
+
+
+@pytest.mark.slow
+def test_run_tune_cli_sweeps_then_caches(tmp_path):
+    """The acceptance flow end to end: --tune sweeps and writes the plan,
+    a second invocation loads it without re-sweeping (real timing path, so
+    marked slow; tier-1 covers the same logic with the injected timer)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    plan_path = tmp_path / "plan.json"
+    cmd = [
+        sys.executable, "-m", "cuda_mpi_gpu_cluster_programming_tpu.run",
+        "--config", "v3_pallas", "--batch", "1", "--height", "43",
+        "--width", "43", "--repeats", "2", "--warmup", "1", "--tune",
+        "--tune-repeats", "2", "--tune-warmup", "1", "--plan", str(plan_path),
+    ]
+    first = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=560, cwd=root
+    )
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert "Tune plan: swept hash=" in first.stdout
+    assert plan_path.exists()
+    second = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=560, cwd=root
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "Tune plan: cache hash=" in second.stdout
