@@ -35,6 +35,12 @@ Tuning: BENCH_PLAN=<tune_plan.json> loads a TunePlan (docs/TUNING.md); each
 row then carries ``plan_hash`` and a ``tuned_vs_default`` sub-object with
 both per-pass times, so tuned adoption is judged from measurements, not
 claims.
+
+Crash-consistent resume: BENCH_JOURNAL=<journal.jsonl> journals every
+successfully measured config row (fsync'd append) the moment it exists. A
+killed sweep relaunched with the same journal replays journaled rows
+without re-measuring and restarts at the first missing config
+(docs/RESILIENCE.md). Unset = the historical measure-everything behavior.
 """
 
 import json
@@ -330,17 +336,19 @@ def _child() -> int:
     return 0
 
 
-def _measure_once() -> list:
+def _measure_once(configs=None) -> list:
     """One full probe+measure pass; returns the JSON row list to emit, one
-    row per BENCH_CONFIGS entry (an ``error`` field marks a failed/wedged
-    row the retry loop may re-run)."""
+    row per ``configs`` entry (default: the full BENCH_CONFIGS list; the
+    journal-resume path passes only the still-missing configs). An
+    ``error`` field marks a failed/wedged row the retry loop may re-run."""
+    configs = list(configs) if configs is not None else CONFIGS
     here = os.path.dirname(os.path.abspath(__file__))
     # 1) Bounded device probe: a wedged tunnel hangs on the tiniest matmul.
     from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
 
     ok, info = probe(PROBE_TIMEOUT)
     if not ok:
-        return [_error_obj(f"device {info}", config=c) for c in CONFIGS]
+        return [_error_obj(f"device {info}", config=c) for c in configs]
     platform = info
 
     # Auto-request a continuity row when the committed headline was captured
@@ -349,6 +357,10 @@ def _measure_once() -> list:
     # fresh capture, not explained away). Explicit BENCH_CONTINUITY_BATCH
     # wins; 0 disables.
     child_env = dict(os.environ)
+    if configs != CONFIGS:
+        # Journal-resume trimmed the sweep: the child must only measure the
+        # still-missing configs (it re-reads BENCH_CONFIGS at import).
+        child_env["BENCH_CONFIGS"] = ",".join(configs)
     if "BENCH_CONTINUITY_BATCH" not in child_env:
         try:
             with open(os.path.join(here, "perf", "bench_latest.json")) as f:
@@ -402,9 +414,9 @@ def _measure_once() -> list:
         f"timed out after {BENCH_TIMEOUT:.0f}s" if timed_out
         else f"rc={proc.returncode}"
     )
-    if any(c in by_config for c in CONFIGS):
+    if any(c in by_config for c in configs):
         rows = []
-        for c in CONFIGS:
+        for c in configs:
             row = by_config.get(c)
             if row is None:
                 rows.append(_error_obj(f"child died before {c} ({why})", platform, c))
@@ -418,12 +430,12 @@ def _measure_once() -> list:
     if timed_out:
         return [
             _error_obj(f"benchmark timed out after {BENCH_TIMEOUT:.0f}s", platform, c)
-            for c in CONFIGS
+            for c in configs
         ]
     tail = ((stderr or stdout or "").strip().splitlines() or ["no output"])[-1:]
     return [
         _error_obj(f"benchmark failed (rc={proc.returncode}): {tail[0]}", platform, c)
-        for c in CONFIGS
+        for c in configs
     ]
 
 
@@ -437,7 +449,12 @@ def main() -> int:
     then carries ``attempts`` / ``resilience`` metadata so retried rows are
     labeled. Always prints exactly ONE parseable JSON line per config
     (historically: one config, one line) and exits 0.
+
+    With BENCH_JOURNAL set, each good row is journaled the moment it is
+    measured and journaled rows are replayed instead of re-measured — a
+    killed sweep restarts at the first missing config.
     """
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,
         FaultLog,
@@ -452,17 +469,44 @@ def main() -> int:
     deadline = Deadline.after(float(os.environ.get("BENCH_DEADLINE_S", "0")) or None)
     flog = FaultLog(site="bench")
 
+    journal = None
+    replayed: dict = {}
+    journal_path = os.environ.get("BENCH_JOURNAL", "")
+    if journal_path:
+        replayed = {
+            key: rec["row"]
+            for key, rec in Journal.completed(
+                Journal.load(journal_path), "bench_row"
+            ).items()
+            if isinstance(rec.get("row"), dict)
+        }
+        journal = Journal(journal_path)
+
     def _row_wedged(row: dict) -> bool:
         value = row.get("value")
         return bool(row.get("error")) or not (
             isinstance(value, (int, float)) and value > 0
         )
 
-    rows: list = []
+    fresh: dict = {}
+    latest: dict = {}  # newest row per config, good or bad (for emission)
     for attempt in range(max(0, policy.max_retries) + 1):
+        pending = [c for c in CONFIGS if c not in replayed and c not in fresh]
+        if not pending:
+            if attempt == 0:
+                flog.record("ok", duration_s=0.0)
+            break
         t0 = time.monotonic()
-        rows = _measure_once()
-        bad = [r for r in rows if _row_wedged(r)]
+        rows = _measure_once(pending)
+        bad = []
+        for c, row in zip(pending, rows):
+            latest[c] = row
+            if _row_wedged(row):
+                bad.append(row)
+            else:
+                fresh[c] = row
+                if journal is not None:
+                    journal.append("bench_row", key=c, row=row)
         if not bad:
             flog.record("ok", duration_s=time.monotonic() - t0)
             break
@@ -478,7 +522,13 @@ def main() -> int:
         pause = min(policy.delay_s(attempt + 1), deadline.remaining())
         flog.record("retry", cause, time.monotonic() - t0, backoff_s=pause)
         time.sleep(pause)
-    for row in rows:
+    for c in CONFIGS:
+        if c in replayed:
+            # Journaled in a previous invocation: emit as measured then —
+            # attempt metadata (if any) is the original run's, not ours.
+            print(json.dumps(replayed[c]))
+            continue
+        row = latest.get(c) or _error_obj("never measured (retry budget)", config=c)
         row["attempts"] = flog.n_attempts
         if flog.retried:
             row["resilience"] = flog.summary()

@@ -36,6 +36,7 @@ from pathlib import Path
 from typing import List, Optional, Sequence
 
 from .resilience import chaos
+from .resilience.journal import JOURNAL_NAME, Journal, atomic_write_text
 from .resilience.policy import DEGRADED, Deadline, FaultLog, RetryPolicy
 from .utils.env_info import cpu_subprocess_env
 
@@ -301,14 +302,33 @@ def git_commit() -> str:
         return "unknown"
 
 
+def _csv_line(values: List) -> str:
+    """One CSV-encoded line (with terminator) — csv handles the quoting."""
+    import io
+
+    buf = io.StringIO()
+    csv.writer(buf).writerow(values)
+    return buf.getvalue()
+
+
 @dataclasses.dataclass
 class Session:
-    """A harness session: one log dir, one CSV (0_run_final_project.sh:15-23)."""
+    """A harness session: one log dir, one CSV (0_run_final_project.sh:15-23),
+    one crash-consistent journal.
+
+    Every committed case is journaled (kind ``case``, the full row keyed by
+    its sweep coordinates) AFTER its CSV append, making the journal the
+    source of truth: ``resume=True`` reopens an interrupted session, REBUILDS
+    the CSV atomically from the journaled rows (dropping any torn row a kill
+    mid-append left behind), and exposes ``completed`` so the sweep skips
+    journaled-complete cases and re-runs interrupted ones.
+    """
 
     log_root: Path
     session_id: str = ""
     machine_id: str = ""
     commit: str = ""
+    resume: bool = False
 
     def __post_init__(self) -> None:
         ts = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
@@ -318,47 +338,97 @@ class Session:
         self.dir = self.log_root / self.session_id
         self.dir.mkdir(parents=True, exist_ok=True)
         self.csv_path = self.dir / "summary.csv"
-        with open(self.csv_path, "w", newline="") as f:
-            csv.writer(f).writerow(CSV_COLUMNS)
+        journal_path = self.dir / JOURNAL_NAME
+        self.completed: dict = {}
+        if self.resume:
+            self.completed = Journal.completed(Journal.load(journal_path), "case")
+            text = _csv_line(CSV_COLUMNS)
+            for rec in self.completed.values():
+                row = rec.get("row", {})
+                text += _csv_line([row.get(c, "") for c in CSV_COLUMNS])
+            atomic_write_text(self.csv_path, text)
+        else:
+            atomic_write_text(self.csv_path, _csv_line(CSV_COLUMNS))
+        self.journal = Journal(journal_path)
         # Environment dump next to the CSV (the pc_v4_environment_info.txt
         # analogue) so analysis can attribute numbers to toolchains. No
         # device probe here — the harness process must not initialize a
         # backend the run subprocesses will claim.
-        from .utils.env_info import collect
+        if not (self.resume and (self.dir / "env.json").exists()):
+            from .utils.env_info import collect
 
-        (self.dir / "env.json").write_text(
-            json.dumps(collect(probe_devices=False), indent=2) + "\n"
+            atomic_write_text(
+                self.dir / "env.json",
+                json.dumps(collect(probe_devices=False), indent=2) + "\n",
+            )
+
+    def log_row(self, r: CaseResult, journal_key: str = "") -> None:
+        values = [
+            self.session_id,
+            self.machine_id,
+            self.commit,
+            datetime.datetime.now().isoformat(timespec="seconds"),
+            r.variant,
+            r.config_key,
+            r.np,
+            r.batch,
+            r.build_status,
+            r.build_msg,
+            r.run_status,
+            r.run_msg,
+            r.parse_status,
+            r.parse_msg,
+            r.status,
+            f"{r.time_ms:.3f}" if r.time_ms is not None else "",
+            f"{r.compile_ms:.1f}" if r.compile_ms is not None else "",
+            r.shape,
+            r.first5,
+            r.log_file,
+            r.attempts,
+            r.resilience_msg or r.degraded_msg,
+            r.plan_hash,
+        ]
+        with open(self.csv_path, "a", newline="") as f:
+            csv.writer(f).writerow(values)
+        # Journal AFTER the CSV append: a kill between the two re-runs the
+        # case on --resume and the rebuilt CSV drops the orphan row, so a
+        # case is never double-counted.
+        self.journal.append(
+            "case",
+            key=journal_key or f"{r.config_key}|np={r.np}|b={r.batch}",
+            row=dict(zip(CSV_COLUMNS, values)),
         )
 
-    def log_row(self, r: CaseResult) -> None:
-        with open(self.csv_path, "a", newline="") as f:
-            csv.writer(f).writerow(
-                [
-                    self.session_id,
-                    self.machine_id,
-                    self.commit,
-                    datetime.datetime.now().isoformat(timespec="seconds"),
-                    r.variant,
-                    r.config_key,
-                    r.np,
-                    r.batch,
-                    r.build_status,
-                    r.build_msg,
-                    r.run_status,
-                    r.run_msg,
-                    r.parse_status,
-                    r.parse_msg,
-                    r.status,
-                    f"{r.time_ms:.3f}" if r.time_ms is not None else "",
-                    f"{r.compile_ms:.1f}" if r.compile_ms is not None else "",
-                    r.shape,
-                    r.first5,
-                    r.log_file,
-                    r.attempts,
-                    r.resilience_msg or r.degraded_msg,
-                    r.plan_hash,
-                ]
-            )
+
+def case_result_from_row(row: dict) -> CaseResult:
+    """Rebuild a CaseResult from a journaled CSV-row dict (the --resume
+    replay path: journaled-complete cases re-enter the summary table and
+    exit-code triage without re-running)."""
+    r = CaseResult(
+        variant=str(row.get("Variant", "")),
+        config_key=str(row.get("ConfigKey", "")),
+        np=int(row.get("NP", 0) or 0),
+        batch=int(row.get("Batch", 0) or 0),
+        build_status=str(row.get("BuildStatus", "OK")),
+        build_msg=str(row.get("BuildMsg", "")),
+        run_status=str(row.get("RunStatus", FAIL)),
+        run_msg=str(row.get("RunMsg", "")),
+        parse_status=str(row.get("ParseStatus", "OK")),
+        parse_msg=str(row.get("ParseMsg", "")),
+        shape=str(row.get("OutputShape", "")),
+        first5=str(row.get("First5Values", "")),
+        log_file=str(row.get("LogFile", "")),
+        attempts=int(row.get("Attempts", 1) or 1),
+        resilience_msg=str(row.get("ResilienceMsg", "")),
+        plan_hash=str(row.get("PlanHash", "")),
+    )
+    if row.get("ExecutionTime_ms"):
+        r.time_ms = float(row["ExecutionTime_ms"])
+    if row.get("Compile_ms"):
+        r.compile_ms = float(row["Compile_ms"])
+    if row.get("Status") == DEGRADED:
+        r.degraded_msg = r.resilience_msg or "DEGRADED (journaled)"
+    return r
 
 
 # Synthetic stdout of a chaos-injected subprocess wedge: the run "succeeds"
@@ -451,6 +521,7 @@ def run_case(
     retry_policy: Optional[RetryPolicy] = None,
     deadline: Optional[Deadline] = None,
     sleep=time.sleep,
+    journal_key: str = "",
 ) -> CaseResult:
     """Run one case with bounded retry + wedge-aware re-capture, then commit
     exactly ONE row (common_test_utils.sh:223-346, hardened).
@@ -466,6 +537,11 @@ def run_case(
     policy = retry_policy or RetryPolicy(max_retries=0)
     deadline = deadline or Deadline.after(None)
     flog = FaultLog(site=f"case:{config_key}/np{np_}/b{batch}")
+    # Journal the attempt BEFORE launching: a case with a start record but
+    # no committed row is exactly the "interrupted" state --resume re-runs.
+    session.journal.append(
+        "case_start", key=journal_key or f"{config_key}|np={np_}|b={batch}"
+    )
     safe_key = config_key.replace(".", "_")
     tag = f"_{log_tag}" if log_tag else ""
 
@@ -534,7 +610,7 @@ def run_case(
         r.shape = r.first5 = ""
         r.parse_status, r.parse_msg = "OK", ""
     r.resilience_msg = flog.summary()
-    session.log_row(r)
+    session.log_row(r, journal_key=journal_key)
     return r
 
 
@@ -632,6 +708,15 @@ def make_parser() -> argparse.ArgumentParser:
         "row's PlanHash column records the plan it actually measured under "
         "(docs/TUNING.md)",
     )
+    p.add_argument(
+        "--resume",
+        default="",
+        metavar="SESSION_DIR",
+        help="resume an interrupted sweep: path to its logs/<session> "
+        "directory. Journaled-complete cases are replayed from the journal "
+        "without re-running; interrupted/missing ones run normally and "
+        "append to the same CSV (docs/RESILIENCE.md)",
+    )
     return p
 
 
@@ -652,7 +737,20 @@ def main(argv=None) -> int:
         print(f"unknown configs: {unknown}", file=sys.stderr)
         return 2
 
-    session = Session(log_root=Path(args.log_root))
+    if args.resume:
+        sdir = Path(args.resume)
+        if not sdir.is_dir():
+            print(f"--resume: no such session directory {sdir}", file=sys.stderr)
+            return 2
+        session = Session(
+            log_root=sdir.parent, session_id=sdir.name, resume=True
+        )
+        print(
+            f"Resuming session {session.session_id}: "
+            f"{len(session.completed)} journaled-complete case(s) will be skipped"
+        )
+    else:
+        session = Session(log_root=Path(args.log_root))
     print(f"Session: {session.session_id} (commit {session.commit})")
     print(f"Logs:    {session.dir}")
 
@@ -687,6 +785,18 @@ def main(argv=None) -> int:
                         if REGISTRY[key].model == "alexnet_full"
                         else []
                     )
+                    case_key = f"{key}|np={np_}|b={batch}|{compute}"
+                    if case_key in session.completed:
+                        r = case_result_from_row(
+                            session.completed[case_key].get("row", {})
+                        )
+                        results.append(r)
+                        print(
+                            f"[{key} np={np_} b={batch} {compute}] "
+                            f"{STATUS_SYMBOL.get(r.status, '?')} {r.status} "
+                            "(journaled, skipped)"
+                        )
+                        continue
                     print(f"[{key} np={np_} b={batch} {compute}] ...", end="", flush=True)
                     r = run_case(
                         session,
@@ -702,6 +812,7 @@ def main(argv=None) -> int:
                         log_tag=compute if len(computes) > 1 else "",
                         retry_policy=policy,
                         deadline=deadline,
+                        journal_key=case_key,
                     )
                     results.append(r)
                     tail = f"{r.time_ms:.1f} ms" if r.time_ms is not None else r.run_msg

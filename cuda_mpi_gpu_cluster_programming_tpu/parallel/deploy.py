@@ -40,6 +40,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..resilience import chaos
+from ..resilience.journal import JOURNAL_NAME, Journal, atomic_writer
 from ..resilience.policy import Deadline, DegradedEvent, FaultLog, RetryPolicy
 from .distributed import ClusterConfig, HostSpec, launch_plan
 
@@ -464,12 +465,27 @@ def deploy_and_collect(
     # Lost hosts (reachability/sync quorum drops) are REPORTED, not erased:
     # they ride the same results list and summary CSV as UNREACHABLE rows.
     results += lost
+    # Journal every host's terminal state (crash-consistent, fsync'd): a
+    # deploy killed between wait() and the summary write still leaves a
+    # durable per-host record an operator/resume tool can read.
+    with Journal(session_dir / JOURNAL_NAME) as jr:
+        for r in results:
+            jr.append(
+                "host",
+                key=f"{r.process_id}:{r.host}",
+                status=r.status,
+                returncode=r.returncode,
+                verdict=r.verdict,
+                time_ms=r.time_ms,
+                log_file=r.log_file,
+            )
     # Summary schema follows the harness/analysis contract (Variant + Status
     # columns) so analysis._csv_kind recognizes it and deploy sessions land
     # in the warehouse like any other session; Host/ProcessID/Verdict are
-    # extra columns the ingester carries through r.get() untouched.
+    # extra columns the ingester carries through r.get() untouched. Written
+    # atomically: readers (warehouse ingest) never see a torn CSV.
     variant = f"MultiHost {script.rsplit('.', 1)[-1]}"
-    with open(session_dir / "summary.csv", "w", newline="") as f:
+    with atomic_writer(session_dir / "summary.csv", "w", newline="") as f:
         w = csv.writer(f)
         w.writerow(
             ["SessionID", "MachineID", "Variant", "NP", "Status",

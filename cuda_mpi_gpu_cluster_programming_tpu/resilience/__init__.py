@@ -13,17 +13,47 @@ scheduling and CheckFreq-style recovery):
   fallback chain emitting structured ``DEGRADED(from, to, cause)`` events
   instead of crashing.
 - ``chaos``   — seed-driven fault injectors (collective failure, device
-  loss, kernel-compile failure, subprocess wedge, ssh/rsync transients)
-  enabled via the ``CHAOS_SPEC`` environment variable so every recovery
-  path is exercisable on CPU in tier-1 tests.
+  loss, kernel-compile failure, subprocess wedge, ssh/rsync transients,
+  sdc bit-flips, nan_loss) enabled via the ``CHAOS_SPEC`` environment
+  variable so every recovery path is exercisable on CPU in tier-1 tests.
+- ``sentinel`` — step-level silent-data-corruption detection: NaN/Inf and
+  norm-spike screening, cross-replica divergence checksums for the
+  dp/sp/tp shard_map paths, periodic golden-oracle spot checks, and the
+  structured ``SDC`` fault class the quarantine/rollback policy consumes.
+- ``journal`` — append-only crash-consistent run journal (fsync'd jsonl
+  appends + atomic tmp-write/rename artifact writes) giving idempotent
+  resume to harness sweeps (``--resume``), bench capture (``BENCH_JOURNAL``)
+  and the train CLI (checkpoint-every-N + last-good rollback).
 
-Wired through ``harness`` (DEGRADED triage + wedge-aware re-capture),
-``parallel.deploy`` (retrying transports + quorum degradation), ``run``
-(``--max-retries/--fallback-chain/--deadline-s``) and the bench capture
+Wired through ``harness`` (DEGRADED triage + wedge-aware re-capture +
+journaled ``--resume``), ``parallel.deploy`` (retrying transports + quorum
+degradation + journaled host states), ``run``
+(``--max-retries/--fallback-chain/--deadline-s``), ``train``
+(``--checkpoint-every`` + sentinel rollback) and the bench capture
 scripts. See docs/RESILIENCE.md.
+
+``sentinel`` imports jax and is therefore NOT re-exported here — the
+stdlib-only consumers (harness, deploy, bench parent) import this package
+without paying a jax import; training-side callers import
+``resilience.sentinel`` directly.
 """
 
-from .chaos import CHAOS_ENV, ChaosInjector, ChaosSpec, InjectedFault, active
+from .chaos import (
+    CHAOS_ENV,
+    KNOWN_SITES,
+    ChaosInjector,
+    ChaosSpec,
+    InjectedFault,
+    active,
+)
+from .journal import (
+    JOURNAL_NAME,
+    Journal,
+    atomic_open,
+    atomic_write_bytes,
+    atomic_write_text,
+    atomic_writer,
+)
 from .policy import (
     DEGRADED,
     Attempt,
@@ -39,6 +69,13 @@ from .policy import (
 
 __all__ = [
     "CHAOS_ENV",
+    "KNOWN_SITES",
+    "JOURNAL_NAME",
+    "Journal",
+    "atomic_open",
+    "atomic_write_bytes",
+    "atomic_write_text",
+    "atomic_writer",
     "ChaosInjector",
     "ChaosSpec",
     "InjectedFault",

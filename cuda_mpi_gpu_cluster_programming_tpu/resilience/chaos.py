@@ -14,8 +14,9 @@ tests — no wedged tunnel required. The spec is a comma-separated list:
                    per-site stream seeded by (seed, site): deterministic
                    for a given spec, order-independent across sites.
 
-Known sites (consumers listed; unknown sites parse fine and simply never
-fire, so specs can outlive code):
+Known sites (consumers listed; an unknown site in a spec is a hard
+ValueError naming the valid kinds — a typo'd drill that silently never
+fires would report "recovery path exercised" without exercising anything):
 
     collective        run CLI build step (sharded strategies) — transient
                       collective/ICI failure.
@@ -26,6 +27,11 @@ fire, so specs can outlive code):
                       (run "succeeds" with value=0.0 output).
     ssh               parallel.deploy transports — transient ssh exit.
     rsync             parallel.deploy transports — transient rsync exit.
+    sdc               train loop — seeded single-bit param corruption
+                      (resilience.sentinel.inject_bit_flip); the sentinel
+                      must detect, roll back, and re-enter.
+    nan_loss          train loop — the step's loss is replaced with NaN;
+                      the sentinel must trip on the same step.
 
 Counters are per-process; CHAOS_SPEC rides the environment into harness/
 deploy children, where each child gets its own deterministic stream.
@@ -39,6 +45,20 @@ import random
 from typing import Dict, Optional
 
 CHAOS_ENV = "CHAOS_SPEC"
+
+# Every injectable fault kind, in consumer order (see module docstring).
+# ``ChaosSpec.parse`` validates against this list so a typo'd drill fails
+# loudly instead of silently never firing.
+KNOWN_SITES = (
+    "collective",
+    "device_loss",
+    "kernel_compile",
+    "subprocess_wedge",
+    "ssh",
+    "rsync",
+    "sdc",
+    "nan_loss",
+)
 
 
 class InjectedFault(RuntimeError):
@@ -69,6 +89,11 @@ class ChaosSpec:
                 raise ValueError(f"malformed CHAOS_SPEC item {item!r} (want site=N|pX)")
             site, _, val = item.partition("=")
             site, val = site.strip(), val.strip()
+            if site != "seed" and site not in KNOWN_SITES:
+                raise ValueError(
+                    f"unknown CHAOS_SPEC fault kind {site!r} "
+                    f"(valid kinds: seed, {', '.join(KNOWN_SITES)})"
+                )
             if site == "seed":
                 seed = int(val)
             elif val.startswith("p"):

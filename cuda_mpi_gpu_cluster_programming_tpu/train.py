@@ -9,6 +9,21 @@ to npz so runs can resume.
 
     python -m cuda_mpi_gpu_cluster_programming_tpu.train --steps 20 --batch 8
     python -m cuda_mpi_gpu_cluster_programming_tpu.train --sp 8 --fake-devices 8
+
+Resilience (docs/RESILIENCE.md): the SDC sentinel screens every step's
+loss/grad-norm/params for NaN/Inf and norm spikes (``--no-sentinel`` opts
+out). ``--checkpoint-every N`` additionally makes the run preemption- and
+corruption-tolerant: the training state (params + optimizer state + step)
+is checkpointed atomically every N steps into ``--work-dir`` alongside a
+crash-consistent journal, a sentinel trip rolls back to the last-good
+checkpoint and re-enters (bounded by ``--max-rollbacks``), and relaunching
+the same command resumes at the last checkpointed step. Batches in this
+mode are derived per step index (identical stream to the prefetching
+loader), so a resumed or rolled-back run replays exactly the batches the
+uninterrupted run would have seen:
+
+    python -m cuda_mpi_gpu_cluster_programming_tpu.train --steps 200 \\
+        --checkpoint-every 20 --work-dir logs/train_work
 """
 
 from __future__ import annotations
@@ -40,7 +55,147 @@ def make_parser() -> argparse.ArgumentParser:
         default=0,
         help="run on N virtual CPU devices (mpirun --oversubscribe analogue)",
     )
+    p.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=0,
+        help="atomically checkpoint the full training state every N steps "
+        "into --work-dir and journal progress; enables idempotent resume "
+        "(relaunch the same command) and sentinel rollback (0 = off, the "
+        "historical run-once behavior)",
+    )
+    p.add_argument(
+        "--work-dir",
+        default="logs/train_work",
+        help="state directory for --checkpoint-every: last-good checkpoint "
+        "+ crash-consistent journal.jsonl",
+    )
+    p.add_argument(
+        "--max-rollbacks",
+        type=int,
+        default=2,
+        help="consecutive sentinel-trip rollbacks tolerated before aborting "
+        "(the counter resets at every successful checkpoint)",
+    )
+    p.add_argument(
+        "--no-sentinel",
+        action="store_true",
+        help="disable the SDC sentinel (NaN/Inf + norm-spike screening of "
+        "loss/grads/params each step)",
+    )
+    p.add_argument(
+        "--sentinel-window",
+        type=int,
+        default=8,
+        help="rolling history length per watched scalar for spike detection",
+    )
+    p.add_argument(
+        "--sentinel-spike",
+        type=float,
+        default=1e3,
+        help="trip when a watched scalar exceeds this factor times its "
+        "window median",
+    )
+    p.add_argument(
+        "--oracle-every",
+        type=int,
+        default=0,
+        help="run the golden-oracle conv spot check (tests/oracle.py) every "
+        "N-th param check; a mismatch trips the sentinel (0 = off)",
+    )
     return p
+
+
+def _run_resilient_loop(
+    args, jr, ckpt_path, start_step, get_batch, teacher_fwd, teacher,
+    step_fn, student, opt_state, sentinel, mesh, flog,
+):
+    """The quarantine-capable training loop (``--checkpoint-every`` > 0).
+
+    Every committed step is journaled; every N-th commit atomically
+    checkpoints (params, opt_state, step) as the last-good state. A
+    sentinel :class:`~..resilience.sentinel.SDC` trip rolls the loop back
+    to that state and re-enters (the chaos ``sdc``/``nan_loss`` drills
+    exercise exactly this path on CPU); ``--max-rollbacks`` consecutive
+    trips without a successful checkpoint abort with rc 3. Returns either
+    an exit code (int) or ``(first_loss, last_loss, steps_run)``.
+    """
+    import jax
+
+    from .resilience import chaos
+    from .resilience.sentinel import SDC
+    from .utils.checkpoint import load_train_state, save_train_state
+
+    first = last = None
+    last_good_step = start_step
+    rollbacks = 0
+    steps_run = 0
+    i = start_step
+    while i < args.steps:
+        x = jax.device_put(get_batch(i))
+        y = teacher_fwd(teacher, x)
+        out = step_fn(student, opt_state, x, y)
+        new_student, new_opt, loss = out[0], out[1], float(out[2])
+        gnorm = float(out[3]) if len(out) > 3 else None
+        ch = chaos.active()
+        if ch is not None:
+            if ch.draw("nan_loss"):
+                print(f"chaos: injected nan_loss at step {i + 1}", flush=True)
+                loss = float("nan")
+            if ch.draw("sdc"):
+                from .resilience.sentinel import inject_bit_flip
+
+                new_student, loc = inject_bit_flip(new_student, seed=ch.spec.seed)
+                print(
+                    f"chaos: injected sdc bit-flip at step {i + 1} "
+                    f"(leaf/elem {loc})",
+                    flush=True,
+                )
+        try:
+            if sentinel is not None:
+                sentinel.check_scalar(i, loss, "loss")
+                if gnorm is not None:
+                    sentinel.check_scalar(i, gnorm, "grad_norm")
+                sentinel.check_tree(i, new_student, "params")
+                if mesh is not None:
+                    sentinel.check_divergence(i, new_student, "params")
+        except SDC as e:
+            rollbacks += 1
+            flog.record("retry", cause=str(e)[:160])
+            jr.append("rollback", key=f"rollback:{i + 1}", step=i + 1, cause=str(e)[:200])
+            print(
+                f"{e} -> rollback to last-good step {last_good_step} "
+                f"(rollback {rollbacks}/{args.max_rollbacks})",
+                flush=True,
+            )
+            if rollbacks > args.max_rollbacks:
+                flog.record("fail", cause="rollback budget exhausted")
+                print(
+                    f"sentinel: {args.max_rollbacks} consecutive rollbacks "
+                    "exhausted without progress; aborting",
+                    file=sys.stderr,
+                )
+                return 3
+            student, opt_state, _ = load_train_state(ckpt_path, student, opt_state)
+            i = last_good_step
+            continue
+        student, opt_state = new_student, new_opt
+        if first is None:
+            first = loss
+        last = loss
+        steps_run += 1
+        print(f"Step {i + 1}/{args.steps}: loss = {loss:.6f}")
+        jr.append("step", key=f"step:{i + 1}", step=i + 1, loss=loss)
+        i += 1
+        if i % args.checkpoint_every == 0 or i == args.steps:
+            save_train_state(ckpt_path, student, opt_state, i)
+            jr.append("ckpt", key=f"ckpt:{i}", step=i)
+            last_good_step = i
+            rollbacks = 0  # progress made: reset the consecutive-trip budget
+    flog.record("ok")
+    if flog.retried:
+        print(f"Sentinel fault log: {flog.summary()}")
+    return first, last, steps_run
 
 
 def main(argv=None) -> int:
@@ -88,10 +243,22 @@ def main(argv=None) -> int:
     mesh = None
     if args.sp or args.dp > 1:
         mesh = make_mesh(args.sp or 1, dp=args.dp)
+    sentinel = None
+    if not args.no_sentinel:
+        from .resilience.sentinel import SDC, Sentinel, SentinelConfig
+
+        sentinel = Sentinel(
+            SentinelConfig(
+                window=args.sentinel_window,
+                spike_factor=args.sentinel_spike,
+                oracle_every=args.oracle_every,
+            )
+        )
+
     opt = optax.adam(args.lr) if args.optimizer == "adam" else optax.sgd(args.lr)
     opt_init, step_fn = make_train_step(
         cfg, mesh=mesh, optimizer=opt, sp_shards=args.sp, tp_shards=args.tp,
-        remat=args.remat,
+        remat=args.remat, with_grad_norm=sentinel is not None,
     )
 
     teacher = init_params_deterministic(cfg)
@@ -116,30 +283,105 @@ def main(argv=None) -> int:
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind}")
 
     shape = (args.batch, cfg.in_height, cfg.in_width, cfg.in_channels)
+    resilient = args.checkpoint_every > 0
+
+    from .resilience import chaos
+    from .resilience.policy import FaultLog
+
+    jr = None
+    ckpt_path = None
+    start_step = 0
+    if resilient:
+        from pathlib import Path
+
+        from .resilience.journal import Journal
+        from .utils.checkpoint import load_train_state, save_train_state
+
+        work = Path(args.work_dir)
+        work.mkdir(parents=True, exist_ok=True)
+        ckpt_path = work / "ckpt_last_good.npz"
+        jr = Journal(work / "journal.jsonl")
+        if ckpt_path.exists():
+            try:
+                student, opt_state, start_step = load_train_state(
+                    ckpt_path, student, opt_state
+                )
+                print(f"Resumed training state from {ckpt_path} at step {start_step}")
+                jr.append("resume", key=f"resume:{start_step}", step=start_step)
+            except (ValueError, KeyError) as e:
+                # A corrupt/mismatched checkpoint must not brick the run —
+                # report it and start fresh (the atomic saver will replace it
+                # at the next boundary).
+                print(f"ignoring unusable checkpoint {ckpt_path}: {e}", file=sys.stderr)
+        if start_step == 0:
+            # The rollback target must exist BEFORE the first step so a trip
+            # at step 1 has a last-good state to quarantine back to.
+            save_train_state(ckpt_path, student, opt_state, 0)
+            jr.append("ckpt", key="ckpt:0", step=0)
+
     first = last = None
     t0 = time.perf_counter()
-    try:
-        loader_cm = native.NativeDataLoader(
-            shape, mode="uniform", seed=args.seed, workers=args.loader_workers
+    if resilient:
+        # Per-step-indexed batches (bit-identical to the loader stream:
+        # batch k = fill_batch(shape, mode, batch_seed(seed, k))) so resume
+        # and rollback replay exactly the batches an uninterrupted run sees.
+        try:
+            native.fill_batch((1, 1, 1, 1))
+        except RuntimeError as e:
+            print(f"cannot build native input tier: {e}", file=sys.stderr)
+            return 2
+
+        def get_batch(k: int):
+            return native.fill_batch(shape, "uniform", native.batch_seed(args.seed, k))
+
+        rc = _run_resilient_loop(
+            args, jr, ckpt_path, start_step, get_batch, teacher_fwd, teacher,
+            step_fn, student, opt_state, sentinel, mesh, FaultLog(site="train-sentinel"),
         )
-    except RuntimeError as e:  # toolchain missing / native build broke
-        print(f"cannot build native input tier: {e}", file=sys.stderr)
-        return 2
-    with loader_cm as loader:
-        for i in range(args.steps):
-            x = jax.device_put(next(loader))
-            y = teacher_fwd(teacher, x)
-            student, opt_state, loss = step_fn(student, opt_state, x, y)
-            loss = float(loss)
-            if first is None:
-                first = loss
-            last = loss
-            print(f"Step {i + 1}/{args.steps}: loss = {loss:.6f}")
+        if isinstance(rc, int):
+            return rc
+        first, last, steps_run = rc
+    else:
+        try:
+            loader_cm = native.NativeDataLoader(
+                shape, mode="uniform", seed=args.seed, workers=args.loader_workers
+            )
+        except RuntimeError as e:  # toolchain missing / native build broke
+            print(f"cannot build native input tier: {e}", file=sys.stderr)
+            return 2
+        with loader_cm as loader:
+            for i in range(args.steps):
+                x = jax.device_put(next(loader))
+                y = teacher_fwd(teacher, x)
+                out = step_fn(student, opt_state, x, y)
+                student, opt_state, loss = out[0], out[1], float(out[2])
+                gnorm = float(out[3]) if len(out) > 3 else None
+                ch = chaos.active()
+                if ch is not None and ch.draw("nan_loss"):
+                    print(f"chaos: injected nan_loss at step {i + 1}", flush=True)
+                    loss = float("nan")
+                if sentinel is not None:
+                    try:
+                        sentinel.check_scalar(i, loss, "loss")
+                        if gnorm is not None:
+                            sentinel.check_scalar(i, gnorm, "grad_norm")
+                    except SDC as e:  # no checkpoint: abort loudly
+                        print(f"{e} (no checkpoint to roll back to; "
+                              "run with --checkpoint-every)", file=sys.stderr)
+                        return 3
+                if first is None:
+                    first = loss
+                last = loss
+                print(f"Step {i + 1}/{args.steps}: loss = {loss:.6f}")
+        steps_run = args.steps
     wall = time.perf_counter() - t0
-    print(
-        f"Training completed in {wall * 1e3:.1f} ms "
-        f"({args.steps} steps, loss {first:.6f} -> {last:.6f})"
-    )
+    if last is None:
+        print(f"Training already complete at step {start_step}/{args.steps} (resumed)")
+    else:
+        print(
+            f"Training completed in {wall * 1e3:.1f} ms "
+            f"({steps_run} steps, loss {first:.6f} -> {last:.6f})"
+        )
 
     if args.checkpoint:
         from .utils.checkpoint import save_params_npz

@@ -27,11 +27,17 @@ def make_train_step(
     sp_shards: int = 0,
     tp_shards: int = 0,
     remat: bool = False,
+    with_grad_norm: bool = False,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for any optax optimizer (default SGD).
 
     ``init_fn(params) -> opt_state``;
     ``step_fn(params, opt_state, x, y) -> (new_params, new_opt_state, loss)``.
+
+    ``with_grad_norm=True`` appends the global gradient L2 norm to the step
+    output (``(new_params, new_opt_state, loss, grad_norm)``) — computed
+    inside the jitted step so the SDC sentinel screens gradients without a
+    second device round-trip.
 
     When ``mesh`` is given, activations are constrained to shard batch over
     "dp" (if present); params stay replicated, so XLA emits the all-reduce
@@ -55,7 +61,7 @@ def make_train_step(
     opt = optimizer if optimizer is not None else optax.sgd(lr)
 
     def _build_step(loss_fn, pre=None, post=None):
-        return _jit_step(opt, loss_fn, pre, post)
+        return _jit_step(opt, loss_fn, pre, post, with_grad_norm=with_grad_norm)
 
     if sp_shards and sp_shards >= 1:
         from .parallel.sharded import build_sharded_forward
@@ -102,7 +108,7 @@ def make_train_step(
     return opt.init, _build_step(loss_fn, pre=pre, post=post)
 
 
-def _jit_step(opt, loss_fn, pre=None, post=None) -> Callable:
+def _jit_step(opt, loss_fn, pre=None, post=None, with_grad_norm=False) -> Callable:
     """The shared update scaffold: (optional pre-constraints) ->
     value_and_grad -> opt.update -> apply_updates -> (optional post) —
     ONE home for the step discipline every trainable uses."""
@@ -116,6 +122,8 @@ def _jit_step(opt, loss_fn, pre=None, post=None) -> Callable:
         new_params = optax.apply_updates(params, updates)
         if post is not None:
             new_params = post(new_params)
+        if with_grad_norm:
+            return new_params, new_opt_state, loss, optax.global_norm(grads)
         return new_params, new_opt_state, loss
 
     return step

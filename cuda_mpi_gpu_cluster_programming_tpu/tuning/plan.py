@@ -180,8 +180,13 @@ def save_plan(plan: TunePlan, path) -> str:
         "%Y-%m-%dT%H:%MZ"
     )
     plans[plan.key] = entry
-    path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_text(json.dumps({"version": PLAN_VERSION, "plans": plans}, indent=2) + "\n")
+    # Atomic replace: the plan cache is a committed run artifact; a crash
+    # mid-save must leave the previous (complete) file, never a torn one.
+    from ..resilience.journal import atomic_write_text
+
+    atomic_write_text(
+        path, json.dumps({"version": PLAN_VERSION, "plans": plans}, indent=2) + "\n"
+    )
     return plan.key
 
 

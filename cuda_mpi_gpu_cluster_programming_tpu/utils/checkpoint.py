@@ -12,15 +12,25 @@ Two formats:
   '/'-joined pytree paths.
 - **orbax** — for large / sharded trees; restores to the sharding of a
   provided target tree (multi-host safe).
+
+Crash consistency: every npz save goes through the resilience layer's
+atomic tmp-write + fsync + rename helper, so a kill mid-save leaves the
+previous checkpoint intact instead of a truncated archive — the property
+the train CLI's last-good rollback depends on. A truncated/corrupt file on
+load raises a uniform ``ValueError`` (not whatever zipfile internals throw)
+so rollback policy can catch one exception type.
 """
 
 from __future__ import annotations
 
+import zipfile
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+from ..resilience.journal import atomic_open
 
 PyTree = Any
 
@@ -66,10 +76,15 @@ def _lists_from_int_dicts(node: PyTree) -> PyTree:
 
 
 def save_params_npz(path: str | Path, params: PyTree) -> Path:
-    """Save a (possibly nested-dict) pytree to one .npz file, bit-exact."""
+    """Save a (possibly nested-dict) pytree to one .npz file, bit-exact.
+
+    Atomic: the archive is written to a tmp file (np.savez gets the open
+    handle, so no '.npz' suffix games), fsync'd, then renamed over ``path``
+    — a crash mid-save can never leave a partial file as the only
+    checkpoint."""
     path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    np.savez(path, **_flatten(params))
+    with atomic_open(path, "wb") as fh:
+        np.savez(fh, **_flatten(params))
     return path
 
 
@@ -83,9 +98,20 @@ def load_params_npz(
     — a tree of the original structure (e.g. a freshly-initialized optimizer
     state) — leaves are restored into *exactly* that structure, so
     ``tree_map`` against the original never hits a structure mismatch.
+
+    A truncated or otherwise corrupt archive raises ``ValueError`` with the
+    path in the message (rollback policy catches exactly this).
     """
-    with np.load(Path(path)) as archive:
-        flat = {k: archive[k] for k in archive.files}
+    try:
+        with np.load(Path(path)) as archive:
+            flat = {k: archive[k] for k in archive.files}
+    except (zipfile.BadZipFile, EOFError, OSError) as e:
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise ValueError(
+            f"checkpoint {path} is truncated or corrupt ({type(e).__name__}: {e}); "
+            "it was not written by the atomic saver or the medium is failing"
+        ) from e
     if like is not None:
         paths, treedef = jax.tree_util.tree_flatten_with_path(like)
         leaves = []
@@ -100,6 +126,36 @@ def load_params_npz(
     if as_jax:
         tree = jax.tree_util.tree_map(jax.numpy.asarray, tree)
     return tree
+
+
+def save_train_state(
+    path: str | Path, params: PyTree, opt_state: PyTree, step: int
+) -> Path:
+    """Atomic one-file training checkpoint: params + optimizer state + the
+    step count they are valid AT (i.e. ``step`` optimizer updates have been
+    applied). This is the last-good state the sentinel rollback restores."""
+    return save_params_npz(
+        path,
+        {"params": params, "opt_state": opt_state, "step": np.asarray(step, np.int64)},
+    )
+
+
+def load_train_state(
+    path: str | Path, like_params: PyTree, like_opt_state: PyTree
+) -> Tuple[PyTree, PyTree, int]:
+    """Restore ``(params, opt_state, step)`` saved by ``save_train_state``
+    into exactly the provided structures (optimizer states are tuples/
+    namedtuples, which need the ``like=`` path). Raises ``ValueError`` on a
+    truncated/corrupt file, ``KeyError`` on a structure mismatch."""
+    like = {
+        "params": like_params,
+        "opt_state": like_opt_state,
+        "step": np.zeros((), np.int64),
+    }
+    tree = load_params_npz(path, as_jax=False, like=like)
+    params = jax.tree_util.tree_map(jax.numpy.asarray, tree["params"])
+    opt_state = jax.tree_util.tree_map(jax.numpy.asarray, tree["opt_state"])
+    return params, opt_state, int(tree["step"])
 
 
 def save_params_orbax(directory: str | Path, params: PyTree) -> Path:

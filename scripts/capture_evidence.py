@@ -36,6 +36,9 @@ ROOT = Path(__file__).resolve().parent.parent
 REFERENCE = Path("/root/reference")
 
 sys.path.insert(0, str(ROOT))
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import (  # noqa: E402
+    atomic_write_text,
+)
 from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe  # noqa: E402
 
 
@@ -164,7 +167,9 @@ def main() -> int:
                     # table — a healed-on-retry headline is still a flag.
                     statuses["bench"] = f"OK ({parsed['attempts']} attempts)"
                 Path(ROOT / "perf").mkdir(exist_ok=True)
-                (ROOT / "perf" / "bench_latest.json").write_text(line + "\n")
+                # Atomic: a crash mid-write must not leave a torn
+                # bench_latest.json as the round's committed headline.
+                atomic_write_text(ROOT / "perf" / "bench_latest.json", line + "\n")
 
     # 4. Perf sweep ranking.
     if not args.skip_perf_sweep:

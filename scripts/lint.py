@@ -20,6 +20,17 @@ bitten this codebase plus the usual hygiene set:
                   fail-open one-shot execution that ate four rounds of
                   bench evidence. A deliberate bounded call site is
                   annotated ``# noqa: raw-subprocess``.
+  atomic-write  — truncating ``open(..., 'w')`` / ``.write_text(...)`` of a
+                  run artifact (a path that statically ends in .csv/.json/
+                  .jsonl or whose identifier mentions csv/json) outside the
+                  sanctioned crash-consistent writers
+                  (``resilience/journal.py``, ``utils/checkpoint.py``) and
+                  tests. A kill mid-write leaves a torn artifact as the
+                  committed record; route through
+                  ``resilience.journal.atomic_write_text``/``atomic_writer``
+                  (append-mode ``'a'`` is fine — appends are what the
+                  journal is for). Deliberate sites:
+                  ``# noqa: atomic-write``.
   variant-env   — direct ``os.environ``/``os.getenv`` READS of the Pallas
                   kernel-variant knobs (TPU_FRAMEWORK_CONV/_POOL/_ROWBLOCK/
                   _KBLOCK/_FUSE/_CHAIN, and any PALLAS_* knob) outside
@@ -91,6 +102,53 @@ def _variant_env_scoped(path: Path) -> bool:
     return "tuning" not in path.parts and path.name != "pallas_kernels.py"
 
 
+# Modules allowed to open run artifacts with a truncating 'w': the atomic
+# writers themselves. Tests are exempt (they build fixtures).
+_ATOMIC_WRITE_EXEMPT_FILES = {"journal.py", "checkpoint.py"}
+_ARTIFACT_SUFFIXES = (".csv", ".json", ".jsonl")
+
+
+def _atomic_write_scoped(path: Path) -> bool:
+    return (
+        path.name not in _ATOMIC_WRITE_EXEMPT_FILES
+        and "tests" not in path.parts
+    )
+
+
+def _static_str_tail(node: ast.expr) -> str:
+    """Best-effort static tail of a path expression: the literal suffix of a
+    Constant / f-string / ``dir / "name.json"`` BinOp / ``Path(...)`` call."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        last = node.values[-1]
+        if isinstance(last, ast.Constant) and isinstance(last.value, str):
+            return last.value
+    if isinstance(node, ast.BinOp):  # pathlib's dir / "file.json"
+        return _static_str_tail(node.right)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "Path"
+        and node.args
+    ):
+        return _static_str_tail(node.args[-1])
+    return ""
+
+
+def _artifact_hint(node: ast.expr) -> bool:
+    """True when a path expression statically looks like a run artifact."""
+    tail = _static_str_tail(node)
+    if tail:
+        return tail.endswith(_ARTIFACT_SUFFIXES)
+    ident = ""
+    if isinstance(node, ast.Name):
+        ident = node.id
+    elif isinstance(node, ast.Attribute):
+        ident = node.attr
+    return any(h in ident.lower() for h in ("csv", "json"))
+
+
 def _is_os_environ(node: ast.expr) -> bool:
     return (
         isinstance(node, ast.Attribute)
@@ -122,6 +180,7 @@ class _Checker(ast.NodeVisitor):
         self.src = src
         self.check_raw_subprocess = _raw_subprocess_scoped(path)
         self.check_variant_env = _variant_env_scoped(path)
+        self.check_atomic_write = _atomic_write_scoped(path)
 
     # --- imports ---
     def visit_Import(self, node: ast.Import) -> None:
@@ -166,6 +225,25 @@ class _Checker(ast.NodeVisitor):
                  "(use parallel.deploy._transport_run or a bounded wrapper; "
                  "annotate deliberate call sites with # noqa: raw-subprocess)")
             )
+        # Truncating writes of run artifacts outside the atomic helpers:
+        # open(<artifact>, "w"...) and <artifact-path>.write_text(...).
+        if self.check_atomic_write:
+            if (
+                isinstance(f, ast.Name)
+                and f.id == "open"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and node.args[1].value.startswith("w")
+                and _artifact_hint(node.args[0])
+            ):
+                self._atomic_write_finding(node.lineno, f"open(..., {node.args[1].value!r})")
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "write_text"
+                and _artifact_hint(f.value)
+            ):
+                self._atomic_write_finding(node.lineno, ".write_text()")
         # os.environ.get("TPU_FRAMEWORK_CONV") / os.getenv(...) of a variant
         # knob outside the sanctioned readers.
         if self.check_variant_env:
@@ -199,6 +277,16 @@ class _Checker(ast.NodeVisitor):
         ):
             self._variant_env_finding(node.lineno, node.slice.value)
         self.generic_visit(node)
+
+    def _atomic_write_finding(self, lineno: int, what: str) -> None:
+        self.findings.append(
+            (self.path, lineno, "atomic-write",
+             f"truncating {what} of a run artifact outside the "
+             "journal/checkpoint helpers — a kill mid-write leaves a torn "
+             "file as committed evidence (use resilience.journal."
+             "atomic_write_text/atomic_writer; deliberate sites: "
+             "# noqa: atomic-write)")
+        )
 
     def _variant_env_finding(self, lineno: int, knob: str) -> None:
         self.findings.append(

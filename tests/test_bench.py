@@ -166,3 +166,93 @@ def test_error_rows_carry_their_config(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "ROOT", str(fake_root))
     assert json.loads(bench._error_json("down"))["config"] == bench.CONFIG
     assert bench._error_obj("down", config="v3_pallas")["config"] == "v3_pallas"
+
+
+def _good_row(config):
+    return {
+        "metric": bench.METRIC, "value": 50.0, "unit": "img/s",
+        "vs_baseline": 9.2, "platform": "cpu", "config": config, "batch": 2,
+    }
+
+
+def test_bench_journal_resume_restarts_at_first_missing_config(tmp_path, monkeypatch, capsys):
+    """BENCH_JOURNAL: a sweep killed after measuring config A relaunches and
+    measures ONLY the missing config B, replaying A's journaled row."""
+    journal = tmp_path / "bench_journal.jsonl"
+    monkeypatch.setenv("BENCH_JOURNAL", str(journal))
+    monkeypatch.setenv("BENCH_MAX_RETRIES", "0")
+    monkeypatch.setattr(bench, "CONFIGS", ["v1_jit", "v3_pallas"])
+    asked = []
+
+    def fake_measure(configs=None):
+        asked.append(list(configs))
+        # First invocation: A measures, then the process "dies" before B
+        # (B yields an error row, as the salvage path reports).
+        rows = []
+        for c in configs:
+            if c == "v3_pallas" and len(asked) == 1:
+                rows.append(bench._error_obj("child died before v3_pallas", "cpu", c))
+            else:
+                rows.append(_good_row(c))
+        return rows
+
+    monkeypatch.setattr(bench, "_measure_once", fake_measure)
+    assert bench.main() == 0
+    out1 = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert [r["config"] for r in out1] == ["v1_jit", "v3_pallas"]
+    assert out1[0]["value"] > 0 and out1[1].get("error")
+    assert asked == [["v1_jit", "v3_pallas"]]
+
+    # Relaunch: only the missing config is measured; A replays from the
+    # journal with its originally measured value (modulo attempt metadata).
+    assert bench.main() == 0
+    out2 = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert asked[1] == ["v3_pallas"]
+    assert [r["config"] for r in out2] == ["v1_jit", "v3_pallas"]
+    assert out2[0]["value"] == out1[0]["value"]
+    assert out2[1]["value"] > 0 and "error" not in out2[1]
+
+    # Third launch: everything journaled — nothing measured at all.
+    assert bench.main() == 0
+    out3 = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(asked) == 2
+    assert [r["config"] for r in out3] == ["v1_jit", "v3_pallas"]
+
+
+def test_bench_no_journal_keeps_historical_contract(monkeypatch, capsys):
+    """Without BENCH_JOURNAL nothing is journaled and every config is
+    measured every run (the historical contract)."""
+    monkeypatch.delenv("BENCH_JOURNAL", raising=False)
+    monkeypatch.setenv("BENCH_MAX_RETRIES", "0")
+    monkeypatch.setattr(bench, "CONFIGS", ["v1_jit"])
+    asked = []
+
+    def fake_measure(configs=None):
+        asked.append(list(configs))
+        return [_good_row(c) for c in configs]
+
+    monkeypatch.setattr(bench, "_measure_once", fake_measure)
+    assert bench.main() == 0
+    assert bench.main() == 0
+    assert asked == [["v1_jit"], ["v1_jit"]]
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert all(r["attempts"] == 1 for r in rows)
+
+
+def test_bench_journal_never_journals_wedged_rows(tmp_path, monkeypatch, capsys):
+    """A wedged/error row must NOT be journaled — replaying a value=0.0 row
+    on resume would recommit the exact garbage the retry loop exists to
+    refuse."""
+    journal = tmp_path / "bench_journal.jsonl"
+    monkeypatch.setenv("BENCH_JOURNAL", str(journal))
+    monkeypatch.setenv("BENCH_MAX_RETRIES", "0")
+    monkeypatch.setattr(bench, "CONFIGS", ["v1_jit"])
+    monkeypatch.setattr(
+        bench, "_measure_once",
+        lambda configs=None: [bench._error_obj("wedged", "cpu", c) for c in configs],
+    )
+    assert bench.main() == 0
+    capsys.readouterr()
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+
+    assert Journal.completed(Journal.load(journal), "bench_row") == {}

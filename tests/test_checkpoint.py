@@ -112,3 +112,70 @@ def test_orbax_restores_fsdp_sharded_placement(tmp_path):
     for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(params)):
         assert a.sharding == b.sharding
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------ crash consistency ---
+
+
+def test_npz_save_is_atomic_on_crash(tmp_path, monkeypatch):
+    """A crash mid-save must leave the PREVIOUS checkpoint intact — no
+    truncated archive, no tmp residue (the last-good rollback contract)."""
+    path = tmp_path / "w.npz"
+    good = init_params_deterministic()
+    ckpt.save_params_npz(path, good)
+    before = path.read_bytes()
+
+    def exploding_savez(fh, **kw):
+        fh.write(b"partial garbage")
+        raise RuntimeError("simulated crash mid-serialization")
+
+    monkeypatch.setattr(ckpt.np, "savez", exploding_savez)
+    try:
+        ckpt.save_params_npz(path, good)
+    except RuntimeError:
+        pass
+    assert path.read_bytes() == before  # old checkpoint untouched
+    assert [f.name for f in tmp_path.iterdir()] == ["w.npz"]  # no tmp residue
+    loaded = ckpt.load_params_npz(path)  # and it still loads
+    assert set(loaded) == {"conv1", "conv2"}
+
+
+def test_truncated_npz_load_raises_clear_value_error(tmp_path):
+    """A torn file (pre-atomic-writer crash, failing medium) must raise one
+    catchable ValueError naming the path, not leak zipfile internals."""
+    import pytest
+
+    path = ckpt.save_params_npz(tmp_path / "w.npz", init_params_deterministic())
+    data = path.read_bytes()
+    path.write_bytes(data[: len(data) // 2])  # truncate
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        ckpt.load_params_npz(path)
+    path.write_bytes(b"")  # zero-length (kill at creation)
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        ckpt.load_params_npz(path)
+
+
+def test_missing_checkpoint_still_file_not_found(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_params_npz(tmp_path / "absent.npz")
+
+
+def test_train_state_roundtrip_sgd_and_adam(tmp_path):
+    """(params, opt_state, step) survive the roundtrip bit-exact into the
+    exact optimizer-state structure (tuples/namedtuples need like=)."""
+    import optax
+
+    params = init_params_random(jax.random.PRNGKey(1))
+    for name, opt in (("sgd", optax.sgd(1e-3)), ("adam", optax.adam(1e-3))):
+        opt_state = opt.init(params)
+        path = tmp_path / f"state_{name}.npz"
+        ckpt.save_train_state(path, params, opt_state, step=17)
+        p2, o2, step = ckpt.load_train_state(path, params, opt_state)
+        assert step == 17
+        assert jax.tree_util.tree_structure(o2) == jax.tree_util.tree_structure(opt_state)
+        for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(p2)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(opt_state), jax.tree_util.tree_leaves(o2)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,107 @@
+"""Crash-consistent journal + atomic-write tests (CPU-only, deterministic).
+
+The property under test everywhere: a kill at ANY instant leaves either the
+previous complete artifact or the new complete artifact — never a torn one —
+and a journal replay skips at most the final partial line.
+"""
+
+import json
+import os
+
+import pytest
+
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import (
+    Journal,
+    atomic_open,
+    atomic_write_bytes,
+    atomic_write_text,
+)
+
+
+def test_atomic_write_text_roundtrip(tmp_path):
+    p = tmp_path / "a" / "row.json"  # parent dir auto-created
+    atomic_write_text(p, '{"x": 1}\n')
+    assert json.loads(p.read_text()) == {"x": 1}
+    # No tmp residue after a clean write.
+    assert [f.name for f in p.parent.iterdir()] == ["row.json"]
+
+
+def test_atomic_write_bytes_roundtrip(tmp_path):
+    p = tmp_path / "blob.bin"
+    atomic_write_bytes(p, b"\x00\x01\x02")
+    assert p.read_bytes() == b"\x00\x01\x02"
+
+
+def test_atomic_open_failure_preserves_previous_file(tmp_path):
+    """A crash mid-write (exception inside the context) must leave the old
+    complete file intact and clean up the tmp file."""
+    p = tmp_path / "committed.json"
+    atomic_write_text(p, "old\n")
+    with pytest.raises(RuntimeError, match="boom"):
+        with atomic_open(p, "w") as fh:
+            fh.write("half-written garba")
+            raise RuntimeError("boom")
+    assert p.read_text() == "old\n"
+    assert [f.name for f in tmp_path.iterdir()] == ["committed.json"]
+
+
+def test_atomic_open_tmp_is_in_target_directory(tmp_path):
+    """The tmp file must live in the target's directory — os.replace across
+    filesystems is not atomic."""
+    seen = {}
+    p = tmp_path / "x.json"
+    with atomic_open(p, "w") as fh:
+        seen["tmp"] = fh.name
+        fh.write("{}")
+    assert os.path.dirname(seen["tmp"]) == str(tmp_path)
+
+
+def test_journal_append_and_load_roundtrip(tmp_path):
+    jp = tmp_path / "journal.jsonl"
+    with Journal(jp) as j:
+        j.append("case_start", key="a")
+        j.append("case", key="a", row={"Status": "OK"})
+        j.append("case", key="b", row={"Status": "FAIL"})
+    recs = Journal.load(jp)
+    assert [r["kind"] for r in recs] == ["case_start", "case", "case"]
+    done = Journal.completed(recs, "case")
+    assert set(done) == {"a", "b"}
+    assert done["a"]["row"] == {"Status": "OK"}
+
+
+def test_journal_load_tolerates_torn_tail(tmp_path):
+    """A SIGKILL mid-append leaves a partial final line; load must skip it
+    and return every complete record."""
+    jp = tmp_path / "journal.jsonl"
+    with Journal(jp) as j:
+        j.append("case", key="a", row={})
+        j.append("case", key="b", row={})
+    with open(jp, "a") as f:
+        f.write('{"kind": "case", "key": "c", "row": {"trunc')  # torn
+    recs = Journal.load(jp)
+    assert [r["key"] for r in recs] == ["a", "b"]
+    assert "c" not in Journal.completed(recs, "case")
+
+
+def test_journal_load_missing_file_is_empty(tmp_path):
+    assert Journal.load(tmp_path / "nope.jsonl") == []
+
+
+def test_journal_completed_later_record_wins(tmp_path):
+    jp = tmp_path / "journal.jsonl"
+    with Journal(jp) as j:
+        j.append("case", key="a", row={"Status": "FAIL"})
+        j.append("case", key="a", row={"Status": "OK"})
+    done = Journal.completed(Journal.load(jp), "case")
+    assert done["a"]["row"] == {"Status": "OK"}
+
+
+def test_journal_appends_survive_reopen(tmp_path):
+    """A second process (resume) appends to the same file without clobbering
+    the first process's records."""
+    jp = tmp_path / "journal.jsonl"
+    with Journal(jp) as j:
+        j.append("case", key="a", row={})
+    with Journal(jp) as j2:
+        j2.append("case", key="b", row={})
+    assert [r["key"] for r in Journal.load(jp)] == ["a", "b"]

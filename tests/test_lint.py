@@ -120,3 +120,56 @@ def test_lint_variant_env_reads_scoped_to_tuning(tmp_path):
             capture_output=True, text=True, timeout=60,
         )
         assert "[variant-env]" not in proc.stdout, proc.stdout
+
+
+def test_lint_atomic_write_rule(tmp_path):
+    """Truncating writes of run artifacts are flagged everywhere except the
+    sanctioned journal/checkpoint helpers; appends, non-artifacts and noqa'd
+    sites pass."""
+    bad = tmp_path / "writer.py"
+    bad.write_text(
+        "import json\n"
+        "from pathlib import Path\n"
+        "def f(rows, session):\n"
+        "    with open('perf/results.json', 'w') as fh:\n"      # flagged
+        "        json.dump(rows, fh)\n"
+        "    (Path('logs') / 'summary.csv').write_text('x')\n"  # flagged
+        "    with open(session.csv_path, 'w') as fh:\n"         # flagged (ident hint)
+        "        fh.write('x')\n"
+        "    with open('rows.jsonl', 'a') as fh:\n"             # append: fine
+        "        fh.write('{}')\n"
+        "    with open('notes.md', 'w') as fh:\n"               # not an artifact
+        "        fh.write('x')\n"
+        "    with open('perf/ok.json', 'w') as fh:  # noqa: atomic-write\n"
+        "        json.dump(rows, fh)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    flagged = [l for l in proc.stdout.splitlines() if "[atomic-write]" in l]
+    assert len(flagged) == 3, proc.stdout
+    assert any(":4:" in l for l in flagged)
+    assert any(":6:" in l for l in flagged)
+    assert any(":7:" in l for l in flagged)
+
+
+def test_lint_atomic_write_exempts_sanctioned_helpers(tmp_path):
+    """The atomic writers themselves (journal.py / checkpoint.py) and tests
+    may open artifacts with 'w' — they ARE the crash-consistent path."""
+    src = (
+        "import json\n"
+        "def f(rows):\n"
+        "    with open('perf/results.json', 'w') as fh:\n"
+        "        json.dump(rows, fh)\n"
+    )
+    for rel in ("journal.py", "checkpoint.py", "tests/test_x.py"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "scripts" / "lint.py"), str(p)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert "[atomic-write]" not in proc.stdout, rel

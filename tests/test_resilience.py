@@ -174,17 +174,28 @@ def test_chaos_probabilistic_injector_deterministic_per_seed():
 
 
 def test_chaos_maybe_raise_and_every_known_site():
-    spec = ",".join(
-        f"{s}=1"
-        for s in ("collective", "device_loss", "kernel_compile",
-                  "subprocess_wedge", "ssh", "rsync")
-    )
+    spec = ",".join(f"{s}=1" for s in chaos.KNOWN_SITES)
     inj = chaos.ChaosInjector(chaos.ChaosSpec.parse(spec))
-    for site in ("collective", "device_loss", "kernel_compile",
-                 "subprocess_wedge", "ssh", "rsync"):
+    for site in chaos.KNOWN_SITES:
         with pytest.raises(chaos.InjectedFault, match=site):
             inj.maybe_raise(site)
         inj.maybe_raise(site)  # healed: no raise
+
+
+def test_chaos_known_sites_include_sdc_and_nan_loss():
+    assert "sdc" in chaos.KNOWN_SITES
+    assert "nan_loss" in chaos.KNOWN_SITES
+
+
+def test_chaos_unknown_fault_kind_is_value_error_listing_valid_kinds():
+    """A typo'd site must fail loudly with the valid vocabulary, not parse
+    fine and silently never fire."""
+    with pytest.raises(ValueError) as ei:
+        chaos.ChaosSpec.parse("ssh_transient=1")
+    msg = str(ei.value)
+    assert "ssh_transient" in msg
+    for site in chaos.KNOWN_SITES:
+        assert site in msg
 
 
 def test_chaos_active_env_gated(monkeypatch):
@@ -595,3 +606,157 @@ def test_deploy_quorum_not_met_raises(tmp_path, monkeypatch):
             quorum=0.9,
             transport_policy=RetryPolicy(max_retries=0, base_delay_s=0.0, jitter=0.0),
         )
+
+
+# ------------------------------------------------- SDC + Degrader ordering ---
+
+
+def test_degrader_sdc_mid_chain_no_skip_no_double_degrade():
+    """An SDC fault firing mid-chain must degrade exactly ONE tier per trip
+    (no tier skipped, no double event) and land on the first healthy tier."""
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.sentinel import SDC
+
+    attempts = []
+
+    def build(tier):
+        attempts.append(tier)
+        if tier == "v5_collective":
+            raise SDC("norm_spike", step=3, detail="loss=1e9")
+        if tier == "v4_hybrid":
+            raise RuntimeError("v4_hybrid down")
+        return f"ok:{tier}"
+
+    d = Degrader(
+        ["v5_collective", "v4_hybrid", "v2.2_sharded"],
+        should_degrade=lambda e: isinstance(e, (SDC, RuntimeError)),
+    )
+    tier, out = d.run(build)
+    assert (tier, out) == ("v2.2_sharded", "ok:v2.2_sharded")
+    # Every tier attempted exactly once, in chain order — no skip.
+    assert attempts == ["v5_collective", "v4_hybrid", "v2.2_sharded"]
+    # One DEGRADED event per failing tier — no double-degrade.
+    assert [(e.from_tier, e.to_tier) for e in d.events] == [
+        ("v5_collective", "v4_hybrid"), ("v4_hybrid", "v2.2_sharded"),
+    ]
+    assert "SDC(norm_spike) at step 3" in d.events[0].cause
+
+
+def test_degrader_sdc_rejected_by_gate_reraises_structured():
+    """A should_degrade gate that rejects SDC re-raises the ORIGINAL
+    structured fault (kind/step intact) — quarantine upstream needs it."""
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.sentinel import SDC
+
+    d = Degrader(["a", "b"], should_degrade=lambda e: not isinstance(e, SDC))
+    with pytest.raises(SDC) as ei:
+        d.run(lambda t: (_ for _ in ()).throw(SDC("nan_loss", step=1)))
+    assert ei.value.kind == "nan_loss" and ei.value.step == 1
+    assert not d.degraded
+
+
+# ------------------------------------------------------- harness --resume ---
+
+_RESUME_STDOUT = (
+    "Compile time: 10.0 ms\n"
+    "Final Output Shape: 13x13x256\n"
+    "Final Output (first 10 values): 1 2 3 4 5 6 7 8 9 10\n"
+    "AlexNet TPU Forward Pass completed in 2.000 ms (amortized over 2 fenced passes; 500.0 img/s)\n"
+)
+
+
+def _fake_run_once_factory(calls, die_on=None):
+    """A _run_once stand-in: records (config, np, batch) per launch, writes a
+    healthy log, and optionally simulates a kill at the Nth launch."""
+
+    def fake(r, cmd, env, log_path, timeout_s, fake_devices):
+        calls.append((r.config_key, r.np, r.batch))
+        if die_on is not None and len(calls) == die_on:
+            raise KeyboardInterrupt  # the sweep process dies mid-case
+        log_path.write_text(_RESUME_STDOUT)
+        r.run_status = harness.OK
+        harness.parse_run_log(_RESUME_STDOUT, r)
+        return _RESUME_STDOUT
+
+    return fake
+
+
+def test_harness_resume_skips_journaled_and_reruns_interrupted(tmp_path, monkeypatch):
+    """Kill a sweep mid-case, relaunch with --resume: journaled-complete
+    cases are skipped, the interrupted case re-runs, and the final CSV holds
+    every case exactly once — identical to an uninterrupted sweep's rows
+    modulo attempt metadata."""
+    args = [
+        "--configs", "v1_jit,v3_pallas", "--shards", "1", "--batches", "1,2",
+        "--log-root", str(tmp_path),
+    ]
+    calls1 = []
+    monkeypatch.setattr(harness, "_run_once", _fake_run_once_factory(calls1, die_on=3))
+    with pytest.raises(KeyboardInterrupt):
+        harness.main(args)
+    assert len(calls1) == 3  # died inside the 3rd case
+    (sdir,) = [d for d in tmp_path.iterdir() if d.is_dir()]
+
+    calls2 = []
+    monkeypatch.setattr(harness, "_run_once", _fake_run_once_factory(calls2))
+    rc = harness.main(args + ["--resume", str(sdir)])
+    assert rc == 0
+    # Only the interrupted case and the never-started one ran.
+    assert calls2 == [("v3_pallas", 1, 1), ("v3_pallas", 1, 2)]
+
+    with open(sdir / "summary.csv", newline="") as f:
+        rows = list(csv.DictReader(f))
+    keys = [(r["ConfigKey"], r["NP"], r["Batch"], r["Status"]) for r in rows]
+    assert sorted(keys) == sorted([
+        ("v1_jit", "1", "1", "OK"), ("v1_jit", "1", "2", "OK"),
+        ("v3_pallas", "1", "1", "OK"), ("v3_pallas", "1", "2", "OK"),
+    ])
+    # The journaled rows replay with their original measured values.
+    v1_rows = [r for r in rows if r["ConfigKey"] == "v1_jit"]
+    assert all(r["ExecutionTime_ms"] == "2.000" for r in v1_rows)
+
+
+def test_harness_resume_on_complete_session_runs_nothing(tmp_path, monkeypatch):
+    args = [
+        "--configs", "v1_jit", "--shards", "1", "--batches", "1",
+        "--log-root", str(tmp_path),
+    ]
+    calls1 = []
+    monkeypatch.setattr(harness, "_run_once", _fake_run_once_factory(calls1))
+    assert harness.main(args) == 0
+    (sdir,) = [d for d in tmp_path.iterdir() if d.is_dir()]
+    calls2 = []
+    monkeypatch.setattr(harness, "_run_once", _fake_run_once_factory(calls2))
+    assert harness.main(args + ["--resume", str(sdir)]) == 0
+    assert calls2 == []  # everything journaled: nothing re-runs
+    with open(sdir / "summary.csv", newline="") as f:
+        assert len(list(csv.DictReader(f))) == 1  # no duplicate rows
+
+
+def test_harness_resume_missing_dir_rejected(tmp_path, capsys):
+    assert harness.main(["--resume", str(tmp_path / "nope")]) == 2
+    assert "no such session" in capsys.readouterr().err
+
+
+def test_harness_resume_drops_torn_csv_row(tmp_path, monkeypatch):
+    """A kill between the CSV append and the journal append leaves an orphan
+    CSV row; --resume rebuilds the CSV from the journal, dropping it, and
+    re-runs that case (no double-count)."""
+    args = [
+        "--configs", "v1_jit", "--shards", "1", "--batches", "1",
+        "--log-root", str(tmp_path),
+    ]
+    calls1 = []
+    monkeypatch.setattr(harness, "_run_once", _fake_run_once_factory(calls1))
+    assert harness.main(args) == 0
+    (sdir,) = [d for d in tmp_path.iterdir() if d.is_dir()]
+    # Simulate the torn state: keep the CSV row, erase the journal's case
+    # record (as if the kill landed between the two appends).
+    jpath = sdir / "journal.jsonl"
+    recs = [l for l in jpath.read_text().splitlines() if '"case_start"' in l]
+    jpath.write_text("\n".join(recs) + "\n")
+
+    calls2 = []
+    monkeypatch.setattr(harness, "_run_once", _fake_run_once_factory(calls2))
+    assert harness.main(args + ["--resume", str(sdir)]) == 0
+    assert calls2 == [("v1_jit", 1, 1)]  # interrupted case re-ran
+    with open(sdir / "summary.csv", newline="") as f:
+        assert len(list(csv.DictReader(f))) == 1  # orphan row was dropped

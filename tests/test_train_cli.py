@@ -57,3 +57,135 @@ def test_too_many_devices_rejected(capsys):
     err = capsys.readouterr().err
     assert rc == 2
     assert "need 64 devices" in err
+
+
+# ----------------------------------------------- sentinel + journal/resume ---
+
+
+def _losses(out):
+    return [float(l.split("loss = ")[1]) for l in out.splitlines() if "loss = " in l]
+
+
+def _chaos(monkeypatch, spec):
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+
+    if spec is None:
+        monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    else:
+        monkeypatch.setenv(chaos.CHAOS_ENV, spec)
+    chaos.reset()
+    return chaos
+
+
+_SMALL = ["--batch", "2", "--height", "63", "--width", "63"]
+
+
+def test_checkpoint_every_matches_loader_stream(tmp_path, capsys, monkeypatch):
+    """The resilient path's per-step-indexed batches are bit-identical to
+    the prefetching loader stream: same seed => same losses."""
+    _chaos(monkeypatch, None)
+    rc1, out_plain = run(["--steps", "3"] + _SMALL, capsys)
+    rc2, out_ck = run(
+        ["--steps", "3", "--checkpoint-every", "2",
+         "--work-dir", str(tmp_path / "w")] + _SMALL,
+        capsys,
+    )
+    assert rc1 == 0 and rc2 == 0
+    assert len(_losses(out_plain)) == 3
+    assert _losses(out_plain) == _losses(out_ck)
+
+
+def test_checkpoint_every_resume_continues_where_killed(tmp_path, capsys, monkeypatch):
+    """Idempotent resume: a run stopped at step 4 relaunched with --steps 8
+    resumes at 4 and lands on exactly the losses of an uninterrupted
+    8-step run."""
+    _chaos(monkeypatch, None)
+    work = str(tmp_path / "w")
+    rc, out1 = run(["--steps", "4", "--checkpoint-every", "2", "--work-dir", work] + _SMALL, capsys)
+    assert rc == 0
+    rc, out2 = run(["--steps", "8", "--checkpoint-every", "2", "--work-dir", work] + _SMALL, capsys)
+    assert rc == 0
+    assert "Resumed training state" in out2 and "at step 4" in out2
+    assert "Step 5/8" in out2 and "Step 1/8" not in out2  # no re-run of done steps
+    rc, out_full = run(
+        ["--steps", "8", "--checkpoint-every", "2", "--work-dir", str(tmp_path / "w2")] + _SMALL,
+        capsys,
+    )
+    assert _losses(out1) + _losses(out2) == _losses(out_full)
+    # Relaunching the finished run is a no-op, not a re-train.
+    rc, out3 = run(["--steps", "8", "--checkpoint-every", "2", "--work-dir", work] + _SMALL, capsys)
+    assert rc == 0 and "already complete at step 8" in out3
+
+
+def test_sdc_bitflip_detected_rolled_back_trajectory_matches_clean(tmp_path, capsys, monkeypatch):
+    """Acceptance: a seeded sdc bit-flip at step k is detected within the
+    sentinel window, training rolls back to the last-good checkpoint and
+    resumes, and the post-resume loss trajectory matches an uninjected run
+    from that checkpoint."""
+    _chaos(monkeypatch, None)
+    args = ["--steps", "6", "--checkpoint-every", "2"] + _SMALL
+    rc, clean = run(args + ["--work-dir", str(tmp_path / "clean")], capsys)
+    assert rc == 0
+
+    chaos = _chaos(monkeypatch, "seed=3,sdc=1")
+    rc, drilled = run(args + ["--work-dir", str(tmp_path / "sdc")], capsys)
+    chaos.reset()
+    assert rc == 0
+    assert "chaos: injected sdc bit-flip" in drilled
+    assert "SDC(" in drilled and "rollback to last-good step" in drilled
+    assert "Sentinel fault log: retried" in drilled
+    # The committed trajectory is EXACTLY the uninjected one.
+    assert _losses(drilled) == _losses(clean)
+    # The incident is journaled.
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+
+    kinds = [r["kind"] for r in Journal.load(tmp_path / "sdc" / "journal.jsonl")]
+    assert "rollback" in kinds and kinds.count("step") == 6
+
+
+def test_nan_loss_drill_rolls_back_and_recovers(tmp_path, capsys, monkeypatch):
+    chaos = _chaos(monkeypatch, "nan_loss=1")
+    rc, out = run(
+        ["--steps", "4", "--checkpoint-every", "2",
+         "--work-dir", str(tmp_path / "w")] + _SMALL,
+        capsys,
+    )
+    chaos.reset()
+    assert rc == 0
+    assert "chaos: injected nan_loss" in out
+    assert "SDC(nan_loss)" in out and "rollback" in out
+    assert len(_losses(out)) == 4  # all steps committed clean after recovery
+
+
+def test_nan_loss_without_checkpoint_aborts_rc3(capsys, monkeypatch):
+    """No checkpoint => nothing to roll back to: the sentinel aborts loudly
+    instead of training on garbage."""
+    chaos = _chaos(monkeypatch, "nan_loss=1")
+    rc = __import__(
+        "cuda_mpi_gpu_cluster_programming_tpu.train", fromlist=["main"]
+    ).main(["--steps", "3"] + _SMALL)
+    chaos.reset()
+    err = capsys.readouterr().err
+    assert rc == 3
+    assert "SDC(nan_loss)" in err and "no checkpoint" in err
+
+
+def test_rollback_budget_exhaustion_aborts_rc3(tmp_path, capsys, monkeypatch):
+    """A persistent corruption source (every step trips) must exhaust
+    --max-rollbacks and abort, not loop forever."""
+    chaos = _chaos(monkeypatch, "nan_loss=99")
+    rc = train.main(
+        ["--steps", "4", "--checkpoint-every", "2", "--max-rollbacks", "2",
+         "--work-dir", str(tmp_path / "w")] + _SMALL
+    )
+    chaos.reset()
+    out, err = capsys.readouterr().out, capsys.readouterr().err
+    assert rc == 3
+
+
+def test_no_sentinel_flag_disables_screening(capsys, monkeypatch):
+    chaos = _chaos(monkeypatch, "nan_loss=1")
+    rc, out = run(["--steps", "2", "--no-sentinel"] + _SMALL, capsys)
+    chaos.reset()
+    assert rc == 0  # NaN sails through: the historical fail-open behavior
+    assert "nan" in out
