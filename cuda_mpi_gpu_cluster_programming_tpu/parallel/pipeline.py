@@ -155,7 +155,9 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
-        check_vma=False,  # psum-of-zeros trick produces a replicated result
+        # noqa: check-vma-disabled — the psum-of-zeros collection trick
+        # produces a genuinely replicated result the checker can't prove.
+        check_vma=False,
     )
     y = fn(staged, x_mb)
     return y.reshape(b, *x.shape[1:])

@@ -1,0 +1,25 @@
+"""Static-analysis subsystem — the clang-tidy analogue, grown from
+scripts/lint.py into a rule registry + two-pass engine.
+
+Run it:
+
+    python -m cuda_mpi_gpu_cluster_programming_tpu.staticcheck [paths...]
+    python scripts/lint.py [paths...]          # thin shim, same contract
+
+Rule catalogue, suppression conventions (``# noqa``, ``# noqa-file``,
+``staticcheck_baseline.json``) and the how-to-add-a-rule recipe live in
+docs/STATIC_ANALYSIS.md.
+"""
+
+from .engine import (  # noqa: F401
+    DEFAULT_PATHS,
+    FileContext,
+    Rule,
+    all_rules,
+    check_files,
+    collect_files,
+    main,
+    register,
+    run,
+)
+from .findings import Finding  # noqa: F401

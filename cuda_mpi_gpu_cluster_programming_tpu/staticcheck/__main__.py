@@ -1,0 +1,8 @@
+"""``python -m cuda_mpi_gpu_cluster_programming_tpu.staticcheck`` entry."""
+
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

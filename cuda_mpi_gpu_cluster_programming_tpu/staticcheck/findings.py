@@ -1,0 +1,121 @@
+"""Finding model + suppression (``# noqa`` / ``# noqa-file``) resolution.
+
+A finding is (path, line, code, message, severity) plus an optional
+``span`` — the inclusive (first, last) physical-line range of the flagged
+construct. Suppressions are resolved against the *span*, not just the
+reported line: a ``# noqa`` anywhere on the flagged statement's lines
+counts, which is what makes multi-line constructs (a decorated def whose
+finding reports the decorator line, a call split over several lines)
+suppressible at all (historical lint.py false-positive: ``_noqa_lines``
+only matched the reported line).
+
+File-level pragma: ``# noqa-file: <code>[, <code>...]`` (or a bare
+``# noqa-file`` for everything) within the FIRST 5 LINES suppresses those
+codes for the whole file — for generated/template-derived files where
+per-line annotations don't survive regeneration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Optional, Set, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_STYLE = "style"
+
+NOQA_FILE_SCAN_LINES = 5
+
+
+@dataclasses.dataclass
+class Finding:
+    path: Path
+    line: int
+    code: str
+    message: str
+    severity: str = SEVERITY_ERROR
+    # Inclusive (first_line, last_line) of the flagged construct; None means
+    # just `line`. Used for noqa resolution only — never shown.
+    span: Optional[Tuple[int, int]] = None
+
+    def location(self, root: Optional[Path] = None) -> str:
+        p = self.path
+        if root is not None:
+            try:
+                p = p.relative_to(root)
+            except ValueError:
+                pass
+        return f"{p}:{self.line}"
+
+    def as_dict(self, root: Optional[Path] = None) -> dict:
+        p = self.path
+        if root is not None:
+            try:
+                p = p.relative_to(root)
+            except ValueError:
+                pass
+        return {
+            "path": str(p),
+            "line": self.line,
+            "code": self.code,
+            "message": self.message,
+            "severity": self.severity,
+        }
+
+
+def _parse_codes(rest: str) -> Set[str]:
+    """Codes after a pragma: ``: a, b`` -> {a, b}; anything else -> {'*'}.
+
+    Each comma-separated token keeps only its first word, so a trailing
+    justification is allowed (and encouraged): ``# noqa: key-reuse same
+    fixture stream on purpose``.
+    """
+    if rest.strip().startswith(":"):
+        return {
+            c.strip().split()[0]
+            for c in rest.strip()[1:].split(",")
+            if c.strip()
+        }
+    return {"*"}
+
+
+def parse_noqa_lines(src: str) -> Dict[int, Set[str]]:
+    """line -> set of suppressed codes ('*' = all)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" not in line:
+            continue
+        _, _, rest = line.partition("# noqa")
+        if rest.startswith("-file"):
+            continue  # the file-level pragma, handled separately
+        out[i] = _parse_codes(rest)
+    return out
+
+
+def parse_noqa_file(src: str) -> Set[str]:
+    """Codes suppressed file-wide ('*' = all) by a header pragma."""
+    codes: Set[str] = set()
+    for line in src.splitlines()[:NOQA_FILE_SCAN_LINES]:
+        if "# noqa-file" not in line:
+            continue
+        _, _, rest = line.partition("# noqa-file")
+        codes |= _parse_codes(rest)
+    return codes
+
+
+def _line_suppresses(noqa: Dict[int, Set[str]], line: int, code: str) -> bool:
+    codes = noqa.get(line)
+    return codes is not None and ("*" in codes or code in codes)
+
+
+def is_suppressed(
+    finding: Finding, noqa: Dict[int, Set[str]], file_codes: Set[str]
+) -> bool:
+    if "*" in file_codes or finding.code in file_codes:
+        return True
+    first, last = finding.span or (finding.line, finding.line)
+    first = min(first, finding.line)
+    last = max(last, finding.line)
+    return any(
+        _line_suppresses(noqa, ln, finding.code) for ln in range(first, last + 1)
+    )

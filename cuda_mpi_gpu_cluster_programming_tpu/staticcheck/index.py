@@ -1,0 +1,208 @@
+"""Pass 1: per-module + repo-wide indexes the rule pass consumes.
+
+The engine parses every file once, builds a :class:`ModuleIndex` for each
+(imports, module-level string constants, function defs, and — the part the
+JAX rules need — the set of mesh/collective *axis names the module binds*),
+and aggregates them into a :class:`RepoIndex` handed to every rule. Pass 2
+(the per-file checkers) then has cross-file context without re-walking
+anything.
+
+Axis-name binding is collected liberally, because the collective-axis rule
+must err toward "bound" (a missed binding is a false positive on working
+code): a name counts as bound in a module if it appears as
+
+- an axis tuple of a ``Mesh(...)`` construction,
+- ``axis_name=`` / ``dp_axis_name=`` / ``axis_names=`` string kwarg of any
+  call (``make_mesh``, ``shard_map``, ``pmap``, ...),
+- a string literal inside any ``PartitionSpec``/``P(...)`` call,
+- a string inside a ``vma=(...)`` kwarg (kernel axis declarations),
+- a string default of a function parameter named ``axis_name``/``axis``/
+  ``dp_axis_name``/``*_axis``,
+- via a module-level string constant (``AXIS = "sp"``) *used* in any of the
+  above positions — ``P(None, AXIS)`` binds ``"sp"``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+_AXIS_KWARGS = {"axis_name", "dp_axis_name", "axis_names"}
+_AXIS_PARAM_NAMES = {"axis_name", "dp_axis_name", "axis", "axes"}
+_SPEC_CALLS = {"P", "PartitionSpec"}
+
+
+def _terminal_attr(func: ast.expr) -> str:
+    """'psum' for lax.psum / jax.lax.psum / bare psum."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    lineno: int
+
+
+@dataclasses.dataclass
+class ModuleIndex:
+    path: Path
+    tree: Optional[ast.AST]
+    src: str
+    syntax_error: Optional[SyntaxError] = None
+    imports: Dict[str, int] = dataclasses.field(default_factory=dict)
+    str_consts: Dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    axis_names: Set[str] = dataclasses.field(default_factory=set)
+
+    def resolve_str(self, node: ast.expr) -> Optional[str]:
+        """Static string value of an expression: literal or module constant."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.str_consts.get(node.id)
+        return None
+
+    def resolve_strs(self, node: ast.expr) -> Optional[List[str]]:
+        """Static string list of an expr that may be a str or tuple of strs.
+
+        Returns None when ANY element is not statically resolvable (the
+        conservative "don't know" answer rules must treat as bound).
+        """
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in node.elts:
+                s = self.resolve_str(elt)
+                if s is None:
+                    return None
+                out.append(s)
+            return out
+        s = self.resolve_str(node)
+        return None if s is None else [s]
+
+
+class _IndexVisitor(ast.NodeVisitor):
+    def __init__(self, mod: ModuleIndex):
+        self.mod = mod
+        self._depth = 0  # function nesting depth (0 = module level)
+
+    # --- imports / constants / functions -------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.mod.imports[(a.asname or a.name).split(".")[0]] = node.lineno
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if a.name != "*":
+                self.mod.imports[a.asname or a.name] = node.lineno
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (
+            self._depth == 0
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            self.mod.str_consts[node.targets[0].id] = node.value.value
+        self.generic_visit(node)
+
+    def _visit_func(self, node) -> None:
+        # Index by bare name; module-level wins over same-named nested defs
+        # (first writer wins — module defs are visited first, at depth 0).
+        if node.name not in self.mod.functions or self._depth == 0:
+            self.mod.functions[node.name] = FunctionInfo(
+                node.name, node, node.lineno
+            )
+        # String defaults of axis-ish params bind that axis name.
+        args = node.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            self._maybe_axis_param(arg, default)
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None:
+                self._maybe_axis_param(arg, default)
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    def _maybe_axis_param(self, arg: ast.arg, default: ast.expr) -> None:
+        name = arg.arg
+        if name in _AXIS_PARAM_NAMES or name.endswith("_axis"):
+            if isinstance(default, ast.Constant) and isinstance(default.value, str):
+                self.mod.axis_names.add(default.value)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # --- axis-name bindings --------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = _terminal_attr(node.func)
+        if callee in _SPEC_CALLS:
+            for a in node.args:
+                self._collect_axis_strs(a)
+        elif callee == "Mesh" and len(node.args) >= 2:
+            self._collect_axis_strs(node.args[1])
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KWARGS or kw.arg == "vma":
+                self._collect_axis_strs(kw.value)
+            elif kw.arg in ("in_specs", "out_specs"):
+                # Spec pytrees: P() calls inside are caught by the P visit;
+                # bare string entries (rare) are collected here.
+                self._collect_axis_strs(kw.value)
+        self.generic_visit(node)
+
+    def _collect_axis_strs(self, node: ast.expr) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                self.mod.axis_names.add(sub.value)
+            elif isinstance(sub, ast.Name):
+                val = self.mod.str_consts.get(sub.id)
+                if val is not None:
+                    self.mod.axis_names.add(val)
+
+
+def index_module(path: Path, src: str) -> ModuleIndex:
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return ModuleIndex(path=path, tree=None, src=src, syntax_error=e)
+    mod = ModuleIndex(path=path, tree=tree, src=src)
+    # Two sweeps so `AXIS = "sp"` resolves no matter where it sits relative
+    # to its uses: constants first, then the full visitor.
+    for stmt in getattr(tree, "body", []):
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            mod.str_consts[stmt.targets[0].id] = stmt.value.value
+    _IndexVisitor(mod).visit(tree)
+    return mod
+
+
+@dataclasses.dataclass
+class RepoIndex:
+    modules: Dict[Path, ModuleIndex] = dataclasses.field(default_factory=dict)
+
+    @property
+    def axis_names(self) -> Set[str]:
+        out: Set[str] = set()
+        for m in self.modules.values():
+            out |= m.axis_names
+        return out
+
+    @classmethod
+    def build(cls, files_with_src) -> "RepoIndex":
+        idx = cls()
+        for path, src in files_with_src:
+            idx.modules[path] = index_module(path, src)
+        return idx

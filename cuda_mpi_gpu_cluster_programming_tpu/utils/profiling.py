@@ -168,7 +168,9 @@ def layer_breakdown(
     rows: List[Tuple[str, float, Tuple[int, ...]]] = []
     cur = x
     for name, fn in stage_fns(cfg, tier=tier):
-        jfn = jax.jit(fn)
+        # Each iteration jits a DIFFERENT stage fn exactly once (per-layer
+        # attribution is the point) — not the retrace-per-iteration footgun.
+        jfn = jax.jit(fn)  # noqa: jit-in-loop
         # Work-floor stats (median of >=3 chains): per-layer times are
         # sub-ms, exactly the regime where a single amortized sample
         # carried ~40% relay noise (round-3 verdict).

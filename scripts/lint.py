@@ -1,390 +1,26 @@
-"""Static-analysis gate — the clang-tidy analogue (stdlib-only).
+"""Static-analysis gate — thin CLI shim over the staticcheck subsystem.
 
-The reference wires clang-tidy into its V4 build via bear/compile_commands
-(reference README.md:172,307; final_project/v4_mpi_cuda/.clang-tidy). This
-image ships no ruff/mypy/flake8 and installs are not allowed, so the gate
-is a self-contained AST checker enforcing the checks that have actually
-bitten this codebase plus the usual hygiene set:
+Historically this file WAS the checker (four ad-hoc rules + hygiene); it is
+now ``cuda_mpi_gpu_cluster_programming_tpu/staticcheck/`` — a rule registry
+with a two-pass engine (repo index, then per-file checkers), JAX/shard_map-
+aware rules, and a committed suppression baseline. The rule catalogue and
+the baseline workflow live in docs/STATIC_ANALYSIS.md.
 
-  syntax        — every file must compile (py_compile).
-  unused-import — imports never referenced (noqa-able).
-  bare-except   — ``except:`` swallows KeyboardInterrupt/SystemExit.
-  mutable-default — list/dict/set literals as parameter defaults.
-  deprecated    — banned API census (see DEPRECATED below), the tidy
-                  checks list; grown as CI surfaces new deprecations.
-  raw-subprocess — bare ``subprocess.run/Popen/call/check_*`` in
-                  ``parallel/`` or ``scripts/``: transport/step execution
-                  there must route through the resilience layer
-                  (``parallel.deploy._transport_run`` or an equivalently
-                  bounded+retried wrapper) so code can't regress to the
-                  fail-open one-shot execution that ate four rounds of
-                  bench evidence. A deliberate bounded call site is
-                  annotated ``# noqa: raw-subprocess``.
-  atomic-write  — truncating ``open(..., 'w')`` / ``.write_text(...)`` of a
-                  run artifact (a path that statically ends in .csv/.json/
-                  .jsonl or whose identifier mentions csv/json) outside the
-                  sanctioned crash-consistent writers
-                  (``resilience/journal.py``, ``utils/checkpoint.py``) and
-                  tests. A kill mid-write leaves a torn artifact as the
-                  committed record; route through
-                  ``resilience.journal.atomic_write_text``/``atomic_writer``
-                  (append-mode ``'a'`` is fine — appends are what the
-                  journal is for). Deliberate sites:
-                  ``# noqa: atomic-write``.
-  variant-env   — direct ``os.environ``/``os.getenv`` READS of the Pallas
-                  kernel-variant knobs (TPU_FRAMEWORK_CONV/_POOL/_ROWBLOCK/
-                  _KBLOCK/_FUSE/_CHAIN, and any PALLAS_* knob) outside
-                  ``tuning/`` and ``ops/pallas_kernels.py``: the tuned-plan
-                  precedence chain (explicit env > TunePlan > default,
-                  docs/TUNING.md) has ONE implementation — a stray read
-                  forks it and resurrects the process-global-variant
-                  footgun. Annotate a deliberate read
-                  ``# noqa: variant-env``.
-  tabs / trailing-ws / long-lines(>120) — formatting conventions.
-
-Run: ``python scripts/lint.py [paths...]`` — exit 0 clean, 1 findings.
-A ``# noqa`` (optionally ``# noqa: <code>``) on the offending line
-suppresses a finding, same convention as ruff/flake8.
+Contract (unchanged): ``python scripts/lint.py [paths...]`` — exit 0 clean,
+1 on new findings. A ``# noqa`` (optionally ``# noqa: <code>``) on any line
+of the offending construct suppresses a finding; ``# noqa-file: <code>`` in
+the first 5 lines suppresses file-wide. ``--format json`` for machines.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import List, Tuple
 
 ROOT = Path(__file__).resolve().parent.parent
-DEFAULT_PATHS = ["cuda_mpi_gpu_cluster_programming_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py"]
-MAX_LINE = 120
+sys.path.insert(0, str(ROOT))
 
-# Deprecated/banned API census (substring, reason). The tidy "checks" list.
-DEPRECATED = [
-    ("lax.pvary", "deprecated in JAX 0.9: use lax.pcast(x, axis, to='varying')"),  # noqa
-    (".tree_multimap", "removed from JAX: use jax.tree_util.tree_map"),  # noqa
-    ("jax.tree_map", "deprecated alias: use jax.tree_util.tree_map"),  # noqa
-    ("np.float_", "removed in NumPy 2.0"),  # noqa
-]
-
-Finding = Tuple[Path, int, str, str]  # file, line, code, message
-
-# Directories where one-shot subprocess execution is a resilience regression
-# (the deploy transports and the evidence-capture scripts); the members
-# checked are the execution entry points, not the module itself.
-_RAW_SUBPROCESS_DIRS = ("parallel", "scripts")
-_SUBPROCESS_CALLS = {"run", "Popen", "call", "check_call", "check_output"}
-
-
-def _raw_subprocess_scoped(path: Path) -> bool:
-    return any(part in _RAW_SUBPROCESS_DIRS for part in path.parts)
-
-
-# Kernel-variant env knobs whose direct reads are confined to tuning/ and
-# ops/pallas_kernels.py (env_variant / KernelVariants.resolve) — keep in
-# sync with tuning.plan.VARIANT_ENV plus the chain knob.
-_VARIANT_KNOBS = {
-    "TPU_FRAMEWORK_CONV",
-    "TPU_FRAMEWORK_POOL",
-    "TPU_FRAMEWORK_ROWBLOCK",
-    "TPU_FRAMEWORK_KBLOCK",
-    "TPU_FRAMEWORK_FUSE",
-    "TPU_FRAMEWORK_CHAIN",
-}
-_VARIANT_KNOB_PREFIXES = ("PALLAS_",)
-
-
-def _is_variant_knob(name: str) -> bool:
-    return name in _VARIANT_KNOBS or name.startswith(_VARIANT_KNOB_PREFIXES)
-
-
-def _variant_env_scoped(path: Path) -> bool:
-    """True = direct variant-knob env reads are forbidden here."""
-    return "tuning" not in path.parts and path.name != "pallas_kernels.py"
-
-
-# Modules allowed to open run artifacts with a truncating 'w': the atomic
-# writers themselves. Tests are exempt (they build fixtures).
-_ATOMIC_WRITE_EXEMPT_FILES = {"journal.py", "checkpoint.py"}
-_ARTIFACT_SUFFIXES = (".csv", ".json", ".jsonl")
-
-
-def _atomic_write_scoped(path: Path) -> bool:
-    return (
-        path.name not in _ATOMIC_WRITE_EXEMPT_FILES
-        and "tests" not in path.parts
-    )
-
-
-def _static_str_tail(node: ast.expr) -> str:
-    """Best-effort static tail of a path expression: the literal suffix of a
-    Constant / f-string / ``dir / "name.json"`` BinOp / ``Path(...)`` call."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr) and node.values:
-        last = node.values[-1]
-        if isinstance(last, ast.Constant) and isinstance(last.value, str):
-            return last.value
-    if isinstance(node, ast.BinOp):  # pathlib's dir / "file.json"
-        return _static_str_tail(node.right)
-    if (
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Name)
-        and node.func.id == "Path"
-        and node.args
-    ):
-        return _static_str_tail(node.args[-1])
-    return ""
-
-
-def _artifact_hint(node: ast.expr) -> bool:
-    """True when a path expression statically looks like a run artifact."""
-    tail = _static_str_tail(node)
-    if tail:
-        return tail.endswith(_ARTIFACT_SUFFIXES)
-    ident = ""
-    if isinstance(node, ast.Name):
-        ident = node.id
-    elif isinstance(node, ast.Attribute):
-        ident = node.attr
-    return any(h in ident.lower() for h in ("csv", "json"))
-
-
-def _is_os_environ(node: ast.expr) -> bool:
-    return (
-        isinstance(node, ast.Attribute)
-        and node.attr == "environ"
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "os"
-    )
-
-
-def _noqa_lines(src: str) -> dict:
-    """line -> set of suppressed codes ('*' = all)."""
-    out = {}
-    for i, line in enumerate(src.splitlines(), 1):
-        if "# noqa" in line:
-            _, _, rest = line.partition("# noqa")
-            if rest.strip().startswith(":"):
-                out[i] = {c.strip() for c in rest.strip()[1:].split(",") if c.strip()}
-            else:
-                out[i] = {"*"}
-    return out
-
-
-class _Checker(ast.NodeVisitor):
-    def __init__(self, path: Path, src: str):
-        self.path = path
-        self.findings: List[Finding] = []
-        self.imported: dict = {}  # name -> lineno
-        self.used: set = set()
-        self.src = src
-        self.check_raw_subprocess = _raw_subprocess_scoped(path)
-        self.check_variant_env = _variant_env_scoped(path)
-        self.check_atomic_write = _atomic_write_scoped(path)
-
-    # --- imports ---
-    def visit_Import(self, node: ast.Import) -> None:
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imported[name] = node.lineno
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imported[a.asname or a.name] = node.lineno
-        self.generic_visit(node)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        root = node
-        while isinstance(root, ast.Attribute):
-            root = root.value
-        if isinstance(root, ast.Name):
-            self.used.add(root.id)
-        self.generic_visit(node)
-
-    # --- raw subprocess execution (parallel//scripts/ only) ---
-    def visit_Call(self, node: ast.Call) -> None:
-        f = node.func
-        if (
-            self.check_raw_subprocess
-            and isinstance(f, ast.Attribute)
-            and f.attr in _SUBPROCESS_CALLS
-            and isinstance(f.value, ast.Name)
-            and f.value.id == "subprocess"
-        ):
-            self.findings.append(
-                (self.path, node.lineno, "raw-subprocess",
-                 f"bare subprocess.{f.attr}() bypasses the retrying transport "
-                 "(use parallel.deploy._transport_run or a bounded wrapper; "
-                 "annotate deliberate call sites with # noqa: raw-subprocess)")
-            )
-        # Truncating writes of run artifacts outside the atomic helpers:
-        # open(<artifact>, "w"...) and <artifact-path>.write_text(...).
-        if self.check_atomic_write:
-            if (
-                isinstance(f, ast.Name)
-                and f.id == "open"
-                and len(node.args) >= 2
-                and isinstance(node.args[1], ast.Constant)
-                and isinstance(node.args[1].value, str)
-                and node.args[1].value.startswith("w")
-                and _artifact_hint(node.args[0])
-            ):
-                self._atomic_write_finding(node.lineno, f"open(..., {node.args[1].value!r})")
-            if (
-                isinstance(f, ast.Attribute)
-                and f.attr == "write_text"
-                and _artifact_hint(f.value)
-            ):
-                self._atomic_write_finding(node.lineno, ".write_text()")
-        # os.environ.get("TPU_FRAMEWORK_CONV") / os.getenv(...) of a variant
-        # knob outside the sanctioned readers.
-        if self.check_variant_env:
-            knob = None
-            if (
-                isinstance(f, ast.Attribute)
-                and f.attr == "get"
-                and _is_os_environ(f.value)
-            ) or (
-                isinstance(f, ast.Attribute)
-                and f.attr == "getenv"
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "os"
-            ):
-                if node.args and isinstance(node.args[0], ast.Constant):
-                    knob = node.args[0].value
-            if isinstance(knob, str) and _is_variant_knob(knob):
-                self._variant_env_finding(node.lineno, knob)
-        self.generic_visit(node)
-
-    def visit_Subscript(self, node: ast.Subscript) -> None:
-        # os.environ["TPU_FRAMEWORK_..."] reads (stores are fine — tests and
-        # harnesses legitimately SET knobs; only reads fork the precedence).
-        if (
-            self.check_variant_env
-            and isinstance(node.ctx, ast.Load)
-            and _is_os_environ(node.value)
-            and isinstance(node.slice, ast.Constant)
-            and isinstance(node.slice.value, str)
-            and _is_variant_knob(node.slice.value)
-        ):
-            self._variant_env_finding(node.lineno, node.slice.value)
-        self.generic_visit(node)
-
-    def _atomic_write_finding(self, lineno: int, what: str) -> None:
-        self.findings.append(
-            (self.path, lineno, "atomic-write",
-             f"truncating {what} of a run artifact outside the "
-             "journal/checkpoint helpers — a kill mid-write leaves a torn "
-             "file as committed evidence (use resilience.journal."
-             "atomic_write_text/atomic_writer; deliberate sites: "
-             "# noqa: atomic-write)")
-        )
-
-    def _variant_env_finding(self, lineno: int, knob: str) -> None:
-        self.findings.append(
-            (self.path, lineno, "variant-env",
-             f"direct read of variant knob {knob!r} outside tuning// "
-             "pallas_kernels.py forks the env > TunePlan > default "
-             "precedence (route through KernelVariants.resolve or "
-             "tuning.plan; deliberate reads: # noqa: variant-env)")
-        )
-
-    # --- bare except ---
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self.findings.append(
-                (self.path, node.lineno, "bare-except",
-                 "bare 'except:' also catches KeyboardInterrupt/SystemExit")
-            )
-        self.generic_visit(node)
-
-    # --- mutable defaults ---
-    def _check_defaults(self, node) -> None:
-        for d in list(node.args.defaults) + [d for d in node.args.kw_defaults if d]:
-            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                self.findings.append(
-                    (self.path, d.lineno, "mutable-default",
-                     f"mutable default argument in {node.name}()")
-                )
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def finish(self) -> None:
-        # __init__.py re-exports and __future__ are legitimate "unused".
-        if self.path.name == "__init__.py":
-            return
-        for name, lineno in self.imported.items():
-            if name in self.used or name == "annotations":
-                continue
-            # Referenced only inside a docstring/string (e.g. doctest) still
-            # counts as unused; that is what # noqa is for.
-            self.findings.append(
-                (self.path, lineno, "unused-import", f"'{name}' imported but unused")
-            )
-
-
-def check_file(path: Path) -> List[Finding]:
-    src = path.read_text(errors="replace")
-    findings: List[Finding] = []
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, "syntax", str(e.msg))]
-    checker = _Checker(path, src)
-    checker.visit(tree)
-    checker.finish()
-    findings.extend(checker.findings)
-
-    for i, line in enumerate(src.splitlines(), 1):
-        if "\t" in line:
-            findings.append((path, i, "tabs", "tab character"))
-        if line != line.rstrip():
-            findings.append((path, i, "trailing-ws", "trailing whitespace"))
-        if len(line) > MAX_LINE:
-            findings.append((path, i, "long-line", f"{len(line)} > {MAX_LINE} chars"))
-        for pat, why in DEPRECATED:
-            if pat in line and not line.lstrip().startswith("#"):
-                findings.append((path, i, "deprecated", f"{pat}: {why}"))
-
-    noqa = _noqa_lines(src)
-    return [
-        f for f in findings
-        if not (f[1] in noqa and ("*" in noqa[f[1]] or f[2] in noqa[f[1]]))
-    ]
-
-
-def main(argv=None) -> int:
-    paths = [Path(p) for p in (argv or sys.argv[1:]) or [ROOT / p for p in DEFAULT_PATHS]]
-    files: List[Path] = []
-    for p in paths:
-        p = Path(p)
-        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
-    all_findings: List[Finding] = []
-    for f in files:
-        all_findings.extend(check_file(f))
-    for path, line, code, msg in all_findings:
-        try:
-            rel = path.relative_to(ROOT)
-        except ValueError:
-            rel = path
-        print(f"{rel}:{line}: [{code}] {msg}")
-    print(f"lint: {len(files)} files, {len(all_findings)} findings")
-    return 1 if all_findings else 0
-
+from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.engine import main  # noqa: E402
 
 if __name__ == "__main__":
     raise SystemExit(main())

@@ -25,6 +25,17 @@ if ! timeout 120 python -u -c "import jax; print((jax.numpy.ones((8,8))@jax.nump
 fi
 echo "${TS} OK (on_heal: queue started)" >> "$PROBE_LOG"
 
+say "staticcheck gate (scripts/lint.py shim; rule catalogue in docs/STATIC_ANALYSIS.md)"
+# The clang-tidy analogue runs BEFORE any chip time is spent: the new
+# JAX rules (wrong-axis collective, unreduced contraction, host sync in a
+# timed loop, key reuse, jit-in-loop, check_vma disables) catch exactly
+# the bug classes that previously burned heal windows. Findings don't
+# abort the queue — evidence capture must still happen — but they are
+# loud in the log and the tier-1 repo-clean gate will fail until fixed.
+if ! timeout 120 python scripts/lint.py 2>&1 | tee -a "$LOG"; then
+    say "STATICCHECK FINDINGS — fix or # noqa before committing this round's evidence"
+fi
+
 # 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
 # timing once turned a ~30 s case into a 600 s timeout. If a test suite is
 # mid-flight when the window opens, wait it out (bounded) instead of

@@ -1,0 +1,474 @@
+"""staticcheck engine + rule coverage.
+
+Three layers per new rule: trigger on a fixture (exactly one finding with
+the expected code — the seeded self-check), suppression via ``# noqa``, and
+suppression via the committed-baseline mechanism. Engine features (noqa
+span resolution, ``# noqa-file`` pragma, baseline semantics, json output)
+get their own cases. The legacy rule set keeps its coverage in
+tests/test_lint.py against the CLI shim.
+"""
+
+import io
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from cuda_mpi_gpu_cluster_programming_tpu.staticcheck import engine  # noqa: E402
+from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.engine import (  # noqa: E402
+    baseline_payload,
+    check_files,
+    split_by_baseline,
+)
+
+
+def findings_for(path: Path, code: str = None):
+    out, _ = check_files([path])
+    return [f for f in out if code is None or f.code == code]
+
+
+def run_engine(paths, baseline_path=None, fmt="text", update=False):
+    buf = io.StringIO()
+    rc = engine.run(
+        paths, baseline_path=baseline_path, fmt=fmt,
+        update_baseline=update, out=buf,
+    )
+    return rc, buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: (filename, source, expected-code, expected-line)
+
+_WRONG_AXIS = (
+    "wrongaxis.py",
+    "from jax import lax, shard_map\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "def body(x):\n"
+    "    return lax.psum(x, 'dp')\n"          # mesh below binds only 'sp'
+    "def build(mesh):\n"
+    "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+    "                     out_specs=P('sp'))\n",
+    "collective-axis",
+    4,
+)
+_UNREDUCED = (
+    "unreduced.py",
+    "import jax.numpy as jnp\n"
+    "from jax import shard_map\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "def body(a, b):\n"
+    "    return jnp.matmul(a, b)\n"
+    "def build(mesh):\n"
+    "    return shard_map(body, mesh=mesh,\n"
+    "                     in_specs=(P(None, 'tp'), P('tp', None)),\n"
+    "                     out_specs=P())\n",
+    "unreduced-contraction",
+    7,
+)
+_HOST_SYNC = (
+    "bench.py",  # the rule is scoped to the measurement surfaces by name
+    "import time\n"
+    "def measure(fn, x, steps):\n"
+    "    times = []\n"
+    "    for _ in range(steps):\n"
+    "        t0 = time.perf_counter()\n"
+    "        out = float(fn(x))\n"
+    "        times.append(time.perf_counter() - t0)\n"
+    "    return times, out\n",
+    "host-sync-in-hot-loop",
+    6,
+)
+_KEY_REUSE = (
+    "keyreuse.py",
+    "import jax\n"
+    "def draws():\n"
+    "    key = jax.random.PRNGKey(0)\n"
+    "    a = jax.random.normal(key, (4,))\n"
+    "    b = jax.random.normal(key, (4,))\n"
+    "    return a, b\n",
+    "key-reuse",
+    5,
+)
+_JIT_IN_LOOP = (
+    "jitloop.py",
+    "import jax\n"
+    "def sweep(fns, x):\n"
+    "    outs = []\n"
+    "    for fn in fns:\n"
+    "        outs.append(jax.jit(fn)(x))\n"
+    "    return outs\n",
+    "jit-in-loop",
+    5,
+)
+_VMA_OFF = (
+    "vmaoff.py",
+    "from jax import shard_map\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "def build(body, mesh):\n"
+    "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+    "                     out_specs=P('sp'), check_vma=False)\n",
+    "check-vma-disabled",
+    5,
+)
+ALL_FIXTURES = [
+    _WRONG_AXIS, _UNREDUCED, _HOST_SYNC, _KEY_REUSE, _JIT_IN_LOOP, _VMA_OFF,
+]
+
+
+@pytest.mark.parametrize(
+    "name,src,code,line", ALL_FIXTURES, ids=[f[2] for f in ALL_FIXTURES]
+)
+def test_rule_triggers_exactly_once(tmp_path, name, src, code, line):
+    """The seeded self-check: each planted bug yields exactly ONE finding
+    with the expected code, at the expected line."""
+    p = tmp_path / name
+    p.write_text(src)
+    got = findings_for(p, code)
+    assert len(got) == 1, [f"{f.code}@{f.line}" for f in findings_for(p)]
+    assert got[0].line == line
+    assert got[0].severity == "error"
+
+
+@pytest.mark.parametrize(
+    "name,src,code,line", ALL_FIXTURES, ids=[f[2] for f in ALL_FIXTURES]
+)
+def test_rule_suppressed_by_noqa(tmp_path, name, src, code, line):
+    lines = src.splitlines()
+    lines[line - 1] += f"  # noqa: {code} deliberate (with a reason)"
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    assert findings_for(p, code) == []
+
+
+@pytest.mark.parametrize(
+    "name,src,code,line", ALL_FIXTURES, ids=[f[2] for f in ALL_FIXTURES]
+)
+def test_rule_grandfathered_by_baseline(tmp_path, name, src, code, line):
+    p = tmp_path / name
+    p.write_text(src)
+    all_findings = findings_for(p)
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps(baseline_payload(all_findings, ROOT)))
+    rc, out = run_engine([p], baseline_path=bp)
+    assert rc == 0, out
+    assert f"[{code}]" not in out
+    assert f"{len(all_findings)} baselined" in out
+
+
+# ---------------------------------------------------------------------------
+# negatives: working idioms must NOT be flagged
+
+
+def test_collective_axis_bound_via_module_constant(tmp_path):
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "from jax import lax, shard_map\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "AXIS = 'sp'\n"
+        "def body(x):\n"
+        "    return lax.psum(x, AXIS)\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P(None, AXIS),),\n"
+        "                     out_specs=P(None, AXIS))\n"
+    )
+    assert findings_for(p, "collective-axis") == []
+
+
+def test_collective_axis_dynamic_name_not_judged(tmp_path):
+    # A variable axis (halo.py-style helper taking axis_name) is not
+    # statically resolvable: never flagged.
+    p = tmp_path / "helper.py"
+    p.write_text(
+        "from jax import lax\n"
+        "def exchange(x, axis_name):\n"
+        "    return lax.ppermute(x, axis_name, [(0, 1)])\n"
+    )
+    assert findings_for(p, "collective-axis") == []
+
+
+def test_unreduced_contraction_ok_with_psum_or_out_axis(tmp_path):
+    base = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax, shard_map\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def body(a, b):\n"
+        "    return {ret}\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh,\n"
+        "                     in_specs=(P(None, 'tp'), P('tp', None)),\n"
+        "                     out_specs={out})\n"
+    )
+    psum = tmp_path / "with_psum.py"
+    psum.write_text(base.format(ret="lax.psum(jnp.matmul(a, b), 'tp')", out="P()"))
+    assert findings_for(psum, "unreduced-contraction") == []
+    kept = tmp_path / "axis_kept.py"
+    kept.write_text(base.format(ret="jnp.matmul(a, b)", out="P(None, 'tp')"))
+    assert findings_for(kept, "unreduced-contraction") == []
+
+
+def test_host_sync_scoping(tmp_path):
+    src = (
+        "import time\n"
+        "def f(rows):\n"
+        "    for r in rows:\n"
+        "        t0 = time.monotonic()\n"
+        "        x = float(r)\n"
+        "        _ = time.monotonic() - t0\n"
+        "    return x\n"
+    )
+    # Same code outside the measurement surfaces: not in scope.
+    other = tmp_path / "parsing.py"
+    other.write_text(src)
+    assert findings_for(other, "host-sync-in-hot-loop") == []
+    # float() in an UNtimed loop (row parsing) is not flagged even in scope.
+    untimed = tmp_path / "harness.py"
+    untimed.write_text(
+        "def f(rows):\n"
+        "    out = [0.0]\n"
+        "    for r in rows:\n"
+        "        out.append(float(r))\n"
+        "    return out\n"
+    )
+    assert findings_for(untimed, "host-sync-in-hot-loop") == []
+    # .item() is a sync regardless of timing calls.
+    item = tmp_path / "training.py"
+    item.write_text(
+        "def f(losses):\n"
+        "    total = 0.0\n"
+        "    for l in losses:\n"
+        "        total += l.item()\n"
+        "    return total\n"
+    )
+    assert len(findings_for(item, "host-sync-in-hot-loop")) == 1
+
+
+def test_key_reuse_split_and_branches_ok(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import jax\n"
+        "def draws(flag):\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    k1, k2 = jax.random.split(key)\n"
+        "    a = jax.random.normal(k1, (4,))\n"
+        "    b = jax.random.normal(k2, (4,))\n"
+        "    if flag:\n"
+        "        c = jax.random.normal(b, (4,))\n"
+        "    else:\n"
+        "        c = jax.random.normal(b, (4,))\n"  # exclusive branch: fine
+        "    return a, c\n"
+    )
+    assert findings_for(ok, "key-reuse") == []
+
+
+def test_key_reuse_loop_invariant_key(tmp_path):
+    p = tmp_path / "loop.py"
+    p.write_text(
+        "import jax\n"
+        "def gen(n):\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    out = []\n"
+        "    for _ in range(n):\n"
+        "        out.append(jax.random.normal(key, (4,)))\n"
+        "    return out\n"
+    )
+    assert len(findings_for(p, "key-reuse")) == 1
+    ok = tmp_path / "loop_ok.py"
+    ok.write_text(
+        "import jax\n"
+        "def gen(n):\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    out = []\n"
+        "    for _ in range(n):\n"
+        "        key, sub = jax.random.split(key)\n"
+        "        out.append(jax.random.normal(sub, (4,)))\n"
+        "    return out\n"
+    )
+    assert findings_for(ok, "key-reuse") == []
+
+
+def test_jit_in_loop_hoisted_ok(tmp_path):
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "import jax\n"
+        "def sweep(fn, xs):\n"
+        "    jfn = jax.jit(fn)\n"
+        "    return [jfn(x) for x in xs]\n"
+    )
+    assert findings_for(p, "jit-in-loop") == []
+
+
+def test_check_vma_computed_value_ok(tmp_path):
+    # check_vma=kernel_check_vma() (the sanctioned pattern) is not a
+    # literal False: never flagged.
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "from jax import shard_map\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def build(body, mesh, flag):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+        "                     out_specs=P('sp'), check_vma=flag)\n"
+    )
+    assert findings_for(p, "check-vma-disabled") == []
+
+
+# ---------------------------------------------------------------------------
+# engine features
+
+
+def test_noqa_resolves_over_statement_span(tmp_path):
+    """The historical false-positive: a multi-line construct whose finding
+    reports one line while the # noqa sits on another line of the same
+    statement. Both directions must suppress."""
+    p = tmp_path / "span.py"
+    p.write_text(
+        "def f(\n"
+        "    a,\n"
+        "    b=[],\n"
+        "):  # noqa: mutable-default\n"
+        "    return a, b\n"
+    )
+    assert findings_for(p, "mutable-default") == []
+    # raw-subprocess on a multi-line call, noqa on the closing line.
+    q = tmp_path / "scripts" / "multi.py"
+    q.parent.mkdir()
+    q.write_text(
+        "import subprocess\n"
+        "subprocess.run(\n"
+        "    ['true'],\n"
+        ")  # noqa: raw-subprocess\n"
+    )
+    assert findings_for(q, "raw-subprocess") == []
+    # Control: without the annotation both fire.
+    r = tmp_path / "scripts" / "bare.py"
+    r.write_text("import subprocess\nsubprocess.run(\n    ['true'],\n)\n")
+    assert len(findings_for(r, "raw-subprocess")) == 1
+
+
+def test_noqa_file_pragma(tmp_path):
+    body = (
+        "import jax\n"
+        "def draws():\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    a = jax.random.normal(key, (4,))\n"
+        "    b = jax.random.normal(key, (4,))\n"
+        "    return a, b\n"
+    )
+    p = tmp_path / "gen.py"
+    p.write_text("# generated file\n# noqa-file: key-reuse\n" + body)
+    assert findings_for(p, "key-reuse") == []
+    # The pragma only counts in the first 5 lines.
+    q = tmp_path / "late.py"
+    q.write_text(body + "# noqa-file: key-reuse\n")
+    assert len(findings_for(q, "key-reuse")) == 1
+    # Bare pragma suppresses everything.
+    r = tmp_path / "all.py"
+    r.write_text("# noqa-file\n" + body + "import os\n")
+    assert findings_for(r) == []
+
+
+def test_baseline_counts_allow_old_fail_new(tmp_path):
+    p = tmp_path / "keyreuse.py"
+    p.write_text(_KEY_REUSE[1])
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps(baseline_payload(findings_for(p), ROOT)))
+    rc, _ = run_engine([p], baseline_path=bp)
+    assert rc == 0
+    # A SECOND reuse in the same file exceeds the grandfathered count: the
+    # extra finding (and only it) fails the run.
+    p.write_text(
+        _KEY_REUSE[1].replace(
+            "    return a, b\n",
+            "    c = jax.random.normal(key, (4,))\n    return a, b, c\n",
+        )
+    )
+    rc, out = run_engine([p], baseline_path=bp)
+    assert rc == 1
+    assert out.count("[key-reuse]") == 1
+    assert "1 baselined" in out
+
+
+def test_baseline_update_roundtrip(tmp_path):
+    p = tmp_path / "keyreuse.py"
+    p.write_text(_KEY_REUSE[1])
+    bp = tmp_path / "baseline.json"
+    rc, _ = run_engine([p], baseline_path=bp, update=True)
+    assert rc == 0 and bp.exists()
+    data = json.loads(bp.read_text())
+    assert data["version"] == 1
+    assert list(data["entries"].values()) == [{"key-reuse": 1}]
+    rc, _ = run_engine([p], baseline_path=bp)
+    assert rc == 0
+
+
+def test_split_by_baseline_orders_by_line(tmp_path):
+    p = tmp_path / "keyreuse.py"
+    p.write_text(
+        _KEY_REUSE[1].replace(
+            "    return a, b\n",
+            "    c = jax.random.normal(key, (4,))\n    return a, b, c\n",
+        )
+    )
+    found = findings_for(p, "key-reuse")
+    assert len(found) == 2
+    baseline = {engine.baseline_key(p, ROOT): {"key-reuse": 1}}
+    new, old = split_by_baseline(found, baseline, ROOT)
+    assert [f.line for f in old] == [5]  # earliest finding grandfathered
+    assert [f.line for f in new] == [6]
+
+
+def test_json_format(tmp_path):
+    p = tmp_path / "keyreuse.py"
+    p.write_text(_KEY_REUSE[1])
+    rc, out = run_engine([p], fmt="json")
+    assert rc == 1
+    data = json.loads(out)
+    assert data["files"] == 1
+    assert data["grandfathered"] == []
+    (f,) = data["new"]
+    assert f["code"] == "key-reuse" and f["line"] == 5
+    assert f["severity"] == "error"
+
+
+def test_syntax_error_single_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    got = findings_for(p)
+    assert len(got) == 1 and got[0].code == "syntax"
+
+
+def test_cli_module_entry_on_fixture(tmp_path):
+    p = tmp_path / "keyreuse.py"
+    p.write_text(_KEY_REUSE[1])
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.staticcheck",
+            "--no-baseline", str(p),
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "[key-reuse]" in proc.stdout
+
+
+def test_cli_list_rules_has_all_new_codes():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.staticcheck",
+            "--list-rules",
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=60,
+    )
+    assert proc.returncode == 0
+    for code in (
+        "collective-axis", "unreduced-contraction", "host-sync-in-hot-loop",
+        "key-reuse", "jit-in-loop", "check-vma-disabled",
+        "raw-subprocess", "atomic-write", "variant-env", "deprecated",
+    ):
+        assert code in proc.stdout, code

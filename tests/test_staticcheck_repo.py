@@ -1,0 +1,48 @@
+"""Repo-clean staticcheck gate — tier-1 IS the CI gate.
+
+The reference gates its V4 build behind clang-tidy; here the analogue is
+this test: the full staticcheck run over the default repo paths (including
+the JAX/shard_map-aware rules) must report zero NEW findings. Grandfathered
+findings live in staticcheck_baseline.json; anything above those counts
+fails this test — fix it or annotate the deliberate site with
+``# noqa: <code> <reason>`` (see docs/STATIC_ANALYSIS.md).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_staticcheck_repo_clean():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.staticcheck",
+            "--format", "json",
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    data = json.loads(proc.stdout) if proc.stdout.strip() else {}
+    assert proc.returncode == 0, (
+        "new staticcheck findings:\n"
+        + "\n".join(
+            f"{f['path']}:{f['line']}: [{f['code']}] {f['message']}"
+            for f in data.get("new", [])
+        )
+        + (proc.stderr or "")
+    )
+
+
+def test_baseline_is_committed_and_well_formed():
+    bp = ROOT / "staticcheck_baseline.json"
+    assert bp.exists(), "staticcheck_baseline.json must be committed"
+    data = json.loads(bp.read_text())
+    assert data.get("version") == 1
+    assert isinstance(data.get("entries"), dict)
+    for codes in data["entries"].values():
+        assert all(
+            isinstance(n, int) and n > 0 for n in codes.values()
+        ), "baseline counts must be positive ints"
