@@ -67,6 +67,7 @@ CSV_COLUMNS = [
     "Attempts",
     "ResilienceMsg",
     "PlanHash",
+    "SupervisorMsg",
 ]
 
 # Exit-code triage classes (common_test_utils.sh:96-116); DEGRADED comes
@@ -212,6 +213,10 @@ _RE_DEGRADED = re.compile(r"^DEGRADED\(.+?\): .*$", re.MULTILINE)
 # rows measured under a tuned per-layer variant plan carry its hash, so a
 # tuned number can never masquerade as a default-lowering one in the CSV.
 _RE_PLAN = re.compile(r"^Tune plan: (?:cache|swept|loaded) hash=([0-9a-f]+)", re.MULTILINE)
+# Elastic-supervisor incident line printed by the run CLI under --supervise
+# (resilience.supervisor.Supervisor.summary): attempts/trips/degradations
+# plus the ladder rung that finally served the batch.
+_RE_SUPERVISOR = re.compile(r"^Supervisor: (.+)$", re.MULTILINE)
 
 
 def is_wedged(r: CaseResult, log_text: str) -> bool:
@@ -247,6 +252,7 @@ class CaseResult:
     resilience_msg: str = ""  # retry/suppression trail (FaultLog.summary)
     degraded_msg: str = ""  # the run CLI's DEGRADED(from -> to) event line
     plan_hash: str = ""  # TunePlan identity the run measured under ("" = untuned)
+    supervisor_msg: str = ""  # the run CLI's 'Supervisor: ...' incident line
 
     @property
     def status(self) -> str:
@@ -387,6 +393,7 @@ class Session:
             r.attempts,
             r.resilience_msg or r.degraded_msg,
             r.plan_hash,
+            r.supervisor_msg,
         ]
         with open(self.csv_path, "a", newline="") as f:
             csv.writer(f).writerow(values)
@@ -421,6 +428,7 @@ def case_result_from_row(row: dict) -> CaseResult:
         attempts=int(row.get("Attempts", 1) or 1),
         resilience_msg=str(row.get("ResilienceMsg", "")),
         plan_hash=str(row.get("PlanHash", "")),
+        supervisor_msg=str(row.get("SupervisorMsg", "")),
     )
     if row.get("ExecutionTime_ms"):
         r.time_ms = float(row["ExecutionTime_ms"])
@@ -505,6 +513,9 @@ def _run_once(
         m = _RE_PLAN.search(text)
         if m:
             r.plan_hash = m.group(1)
+        m = _RE_SUPERVISOR.search(text)
+        if m:
+            r.supervisor_msg = m.group(1)[:200]
     return text
 
 
@@ -709,6 +720,15 @@ def make_parser() -> argparse.ArgumentParser:
         "(docs/TUNING.md)",
     )
     p.add_argument(
+        "--supervise",
+        action="store_true",
+        help="forwarded to every case's run CLI: run under the elastic "
+        "supervisor (in-graph digest screening + shard-ladder re-planning); "
+        "each row's SupervisorMsg column records the incident trail, and a "
+        "case that finished on a lower rung triages as DEGRADED "
+        "(docs/RESILIENCE.md). Blocks 1-2 configs only",
+    )
+    p.add_argument(
         "--resume",
         default="",
         metavar="SESSION_DIR",
@@ -759,6 +779,8 @@ def main(argv=None) -> int:
         extra += ["--fallback-chain", args.fallback_chain]
     if args.plan:
         extra += ["--plan", args.plan]
+    if args.supervise:
+        extra += ["--supervise"]
     policy = RetryPolicy(max_retries=max(0, args.max_retries), base_delay_s=args.retry_backoff)
     deadline = Deadline.after(args.deadline_s or None)
     results: List[CaseResult] = []

@@ -1,0 +1,52 @@
+"""JAX version compatibility for the shard_map API family.
+
+The framework targets the current TPU toolchain (top-level
+``jax.shard_map`` with the ``check_vma`` varying-manual-axes checker and
+``lax.pcast``/pvary annotations), but the CPU CI containers can lag
+releases behind — where shard_map still lives in ``jax.experimental`` and
+the checker is spelled ``check_rep``. Every in-repo shard_map construction
+routes through this module so one shim absorbs the API drift instead of
+each builder growing its own try/except (the collection errors this file
+heals were exactly that: ``from jax import shard_map`` dying at import time
+on older containers, taking the whole sharded test family with it).
+
+``to_varying(axes)`` is the matching shim for the loop-carry annotations:
+identity on jax builds without pcast/pvary (their rep system does not
+distinguish varying from replicated in fori_loop carries).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+try:  # current API: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pre-export releases: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the checker kwarg spelled per the installed
+    release (``check_vma`` today, ``check_rep`` on older containers)."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def to_varying(axes) -> Callable:
+    """Annotate an array as varying over ``axes`` for fori_loop carry typing
+    — lax.pcast (current), pvary (the deprecated alias), or identity on
+    releases whose rep system has no varying annotation at all."""
+    from jax import lax
+
+    axes = tuple(axes)
+    if hasattr(lax, "pcast"):
+        return lambda a: lax.pcast(a, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lambda a: lax.pvary(a, axes)  # noqa — pre-pcast fallback
+    return lambda a: a

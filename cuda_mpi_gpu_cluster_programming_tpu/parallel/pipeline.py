@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import shard_map
 from .mesh import make_mesh
 
 Params = Any
@@ -150,7 +151,7 @@ def pipeline_apply(
         # of x_mb) and of the output sharded over dp.
         in_specs = (P(axis_name), P(None, dp_axis))
         out_specs = P(None, dp_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         stage_body,
         mesh=mesh,
         in_specs=in_specs,

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import NEG_INF
 from ..ops.vma import kernel_check_vma
+from .compat import shard_map, to_varying
 from .mesh import make_mesh
 
 
@@ -93,15 +94,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
 
 
 def _to_varying_fn(axes):
-    # lax.pcast(..., to='varying') is the current spelling; pvary is the
-    # deprecated alias kept as a fallback for older JAX builds. ``axes``:
-    # every mesh axis the loop carry varies over — with a head_axis (sp x
-    # tp composition) the K/V inputs vary over BOTH, and fori_loop demands
-    # carry-in/carry-out type equality.
-    axes = tuple(axes)
-    if hasattr(lax, "pcast"):
-        return lambda a: lax.pcast(a, axes, to="varying")
-    return lambda a: lax.pvary(a, axes)  # noqa — pre-pcast JAX fallback
+    # lax.pcast(..., to='varying') is the current spelling; pvary the
+    # deprecated alias; identity on releases without either (their rep
+    # system does not type fori_loop carries as varying). ``axes``: every
+    # mesh axis the loop carry varies over — with a head_axis (sp x tp
+    # composition) the K/V inputs vary over BOTH, and fori_loop demands
+    # carry-in/carry-out type equality. One implementation: parallel.compat.
+    return to_varying(axes)
 
 
 def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int, causal: bool, vary_axes=None):
@@ -219,8 +218,14 @@ def ring_attention(
     axis_name: str = "sp",
     engine: str = "einsum",
     head_axis: Optional[str] = None,
+    with_digests: bool = False,
 ) -> jax.Array:
     """Sequence-sharded blockwise ring attention. q,k,v: (B, L, H, D).
+
+    ``with_digests``: return ``(out, {"qkv": (n,), "out": (n,)})`` — one
+    in-graph activation digest per shard of the inputs and of the attention
+    output, computed inside the shard_map body (the SDC sentinel taps; see
+    ``parallel.sharded``). Screening is host-side and off the timed path.
 
     The sequence axis is sharded ``n_shards`` ways; K/V blocks ride the ring
     via ``ppermute`` (ICI neighbor traffic, the same collective as the conv
@@ -275,8 +280,14 @@ def ring_attention(
         vary_axes=vary,
     )
     spec = P(None, axis_name, head_axis, None)
-    fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    fn = shard_map(
+        _with_stage_digests(body) if with_digests else body,
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(
+            (spec, {"qkv": P(axis_name), "out": P(axis_name)})
+            if with_digests
+            else spec
+        ),
         # Flash engine: checker ON wherever the kernels can tag vma (real
         # TPU) — ops.vma.kernel_check_vma; the blanket disable now only
         # survives in interpret mode, where jax's own interpreter can't
@@ -284,6 +295,20 @@ def ring_attention(
         check_vma=(engine != "flash" or kernel_check_vma()),
     )
     return fn(q, k, v)
+
+
+def _with_stage_digests(body):
+    """Wrap a per-shard attention body with in-graph sentinel taps: digest
+    the (q, k, v) inputs and the output on each shard (one float32 scalar
+    apiece, concatenated across shards by the caller's out_specs)."""
+    from ..resilience.sentinel import tree_digest
+
+    def tapped(q, k, v):
+        out = body(q, k, v)
+        digs = {"qkv": tree_digest((q, k, v))[None], "out": tree_digest(out)[None]}
+        return out, digs
+
+    return tapped
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, engine: str, vary_axes=None):  # noqa: D401
@@ -329,8 +354,12 @@ def ulysses_attention(
     axis_name: str = "sp",
     engine: str = "einsum",
     head_axis: Optional[str] = None,
+    with_digests: bool = False,
 ) -> jax.Array:
     """All-to-all (Ulysses-style) sequence parallelism. q,k,v: (B, L, H, D).
+
+    ``with_digests``: as in :func:`ring_attention` — per-shard in-graph
+    digests of the inputs and output ride alongside the result.
 
     Resharding sequence->heads makes each shard run *exact* attention over
     the full sequence for ``H/n`` heads; two tiled ``all_to_all`` collectives
@@ -375,8 +404,14 @@ def ulysses_attention(
         vary_axes=(axis_name,) + ((head_axis,) if head_axis else ()),
     )
     spec = P(None, axis_name, head_axis, None)
-    fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    fn = shard_map(
+        _with_stage_digests(body) if with_digests else body,
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(
+            (spec, {"qkv": P(axis_name), "out": P(axis_name)})
+            if with_digests
+            else spec
+        ),
         # Same policy as ring: flash keeps the checker wherever the kernel
         # can tag vma (real TPU); einsum always.
         check_vma=(engine != "flash" or kernel_check_vma()),

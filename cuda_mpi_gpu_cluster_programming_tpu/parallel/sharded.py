@@ -29,11 +29,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from ..models.alexnet import BLOCKS12, Blocks12Config
 from ..ops import reference as ops
 from ..ops.vma import kernel_check_vma
+from .compat import shard_map
 from .halo import exchange
 from .mesh import make_mesh
 from .plan import LayerPlan, make_shard_plan
@@ -100,15 +100,26 @@ def build_sharded_forward(
     mesh: Optional[Mesh] = None,
     tier: str = "reference",
     staged: bool = False,
+    with_digests: bool = False,
 ) -> Callable:
     """Jitted ``(params, x) -> out`` running row-sharded over ``n_shards``.
 
     ``x`` is the full (N, H, W, C) array; output is the full
     (N, H', W', C') array — scatter/gather are implicit in the shardings.
+
+    ``with_digests``: additionally return a per-stage activation digest
+    tree, ``(out, {layer_name: (n_shards,) float32})`` — one
+    ``tree_digest`` per Conv1/Pool1/Conv2/Pool2/LRN2 boundary, computed
+    INSIDE the shard_map body (the in-graph SDC sentinel taps). The digests
+    are device scalars riding alongside the output: nothing syncs to host
+    until a screener (``resilience.sentinel.StageDigests``) fetches them
+    off the timed path, so the hot loop stays free of host round trips.
     """
     mesh = mesh or make_mesh(n_shards, axis_name=AXIS)
     n = n_shards
     plan = make_shard_plan(model_cfg, n)
+    if with_digests:
+        from ..resilience.sentinel import tree_digest
 
     if tier == "pallas":
         import functools
@@ -137,6 +148,7 @@ def build_sharded_forward(
     def shard_body(params, xb):
         # xb: (N, b0, W, C) — this shard's rows (zero-padded past H)
         cur = xb
+        digs = {}
         for lp in plan.layers:
             spec = specs[lp.name]
             if lp.kind == "pointwise":
@@ -153,13 +165,23 @@ def build_sharded_forward(
                     lp, cur, params, spec, AXIS, n, conv_fn, pool_fn, staged
                 )
                 cur = ops.relu(cur) if lp.kind == "conv" else cur
-        return cur
+            if with_digests:
+                # In-graph sentinel tap: one float32 digest of this shard's
+                # block at the layer boundary. Shard-varying (each shard
+                # digests its own rows) — concatenated to (n,) by out_specs.
+                digs[lp.name] = tree_digest(cur)[None]
+        return (cur, digs) if with_digests else cur
 
+    out_spec = P(None, AXIS, None, None)
+    if with_digests:
+        out_specs = (out_spec, {lp.name: P(AXIS) for lp in plan.layers})
+    else:
+        out_specs = out_spec
     sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(None, AXIS, None, None)),
-        out_specs=P(None, AXIS, None, None),
+        out_specs=out_specs,
         # Pallas tier: checker ON wherever the kernels can tag their
         # out_shapes with vma (real TPU — ops.vma.kernel_check_vma); the
         # disable now only survives in interpret mode. Reference tier:
@@ -175,6 +197,9 @@ def build_sharded_forward(
         pad = h_pad - x.shape[1]
         if pad:
             x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if with_digests:
+            out, digs = sharded(params, x)
+            return out[:, :l_final], digs
         out = sharded(params, x)  # (N, n*b_final, W', C')
         return out[:, :l_final]
 

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.alexnet import BLOCKS12, Blocks12Config
 from ..ops.reference import conv2d, lrn, maxpool, relu
+from .compat import shard_map
 from .mesh import make_mesh
 
 
@@ -58,8 +59,15 @@ def build_tp_forward(
     n_shards: int = 1,
     mesh: Optional[Mesh] = None,
     axis_name: str = "tp",
+    with_digests: bool = False,
 ) -> Callable:
-    """Jitted ``(params, x) -> out`` with conv filters K-sharded n ways."""
+    """Jitted ``(params, x) -> out`` with conv filters K-sharded n ways.
+
+    ``with_digests``: return ``(out, {layer: (n_shards,) float32})`` with
+    one in-graph activation digest per Conv1/Pool1/Conv2/Pool2/LRN2
+    boundary, taken on each shard's LOCAL channel slice inside the
+    shard_map body (the SDC sentinel taps — see ``parallel.sharded``).
+    """
     cfg = model_cfg
     for name, spec in (("conv1", cfg.conv1), ("conv2", cfg.conv2)):
         if spec.out_channels % n_shards:
@@ -84,16 +92,28 @@ def build_tp_forward(
                 f"tp n_shards={n_shards}; the filter slices would not line up"
             )
 
+    if with_digests:
+        from ..resilience.sentinel import tree_digest
+
     def local(params, x):
         p1, p2 = params["conv1"], params["conv2"]
+        digs = {}
+
+        def tap(name, v):
+            # In-graph sentinel tap on the shard-LOCAL channel slice; one
+            # float32 scalar per shard, concatenated to (n,) by out_specs.
+            if with_digests:
+                digs[name] = tree_digest(v)[None]
+            return v
+
         # Block 1 on this shard's filter slice: (B, h, w, K1/n).
-        y = relu(conv2d(x, p1["w"], p1["b"], stride=cfg.conv1.stride, padding=cfg.conv1.padding))
-        y = maxpool(y, window=cfg.pool1.window, stride=cfg.pool1.stride)
+        y = tap("conv1", relu(conv2d(x, p1["w"], p1["b"], stride=cfg.conv1.stride, padding=cfg.conv1.padding)))
+        y = tap("pool1", maxpool(y, window=cfg.pool1.window, stride=cfg.pool1.stride))
         # conv2 needs every conv1 channel: gather the channel axis (the TP
         # boundary collective — activations are small here, 27x27x96).
         y = lax.all_gather(y, axis_name, axis=3, tiled=True)
-        z = relu(conv2d(y, p2["w"], p2["b"], stride=cfg.conv2.stride, padding=cfg.conv2.padding))
-        z = maxpool(z, window=cfg.pool2.window, stride=cfg.pool2.stride)
+        z = tap("conv2", relu(conv2d(y, p2["w"], p2["b"], stride=cfg.conv2.stride, padding=cfg.conv2.padding)))
+        z = tap("pool2", maxpool(z, window=cfg.pool2.window, stride=cfg.pool2.stride))
         # LRN crosses channels: exchange `half` neighbor channels, normalize,
         # keep the owned slice.
         if n_shards > 1:
@@ -108,18 +128,26 @@ def build_tp_forward(
             k=cfg.lrn2.k,
             alpha_over_size=cfg.lrn2.alpha_over_size,
         )
-        return zl[..., half:-half] if n_shards > 1 else zl
+        out = zl[..., half:-half] if n_shards > 1 else zl
+        tap("lrn2", out)
+        return (out, digs) if with_digests else out
 
     wspec = P(None, None, None, axis_name)  # HWIO: shard the O axis
     pspec = {
         "conv1": {"w": wspec, "b": P(axis_name)},
         "conv2": {"w": wspec, "b": P(axis_name)},
     }
-    fn = jax.shard_map(
+    out_spec = P(None, None, None, axis_name)
+    stages = ("conv1", "pool1", "conv2", "pool2", "lrn2")
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(pspec, P()),
-        out_specs=P(None, None, None, axis_name),
+        out_specs=(
+            (out_spec, {s: P(axis_name) for s in stages})
+            if with_digests
+            else out_spec
+        ),
     )
     return jax.jit(fn)
 

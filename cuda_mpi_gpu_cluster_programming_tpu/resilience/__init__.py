@@ -22,8 +22,15 @@ scheduling and CheckFreq-style recovery):
   structured ``SDC`` fault class the quarantine/rollback policy consumes.
 - ``journal`` — append-only crash-consistent run journal (fsync'd jsonl
   appends + atomic tmp-write/rename artifact writes) giving idempotent
-  resume to harness sweeps (``--resume``), bench capture (``BENCH_JOURNAL``)
-  and the train CLI (checkpoint-every-N + last-good rollback).
+  resume to harness sweeps (``--resume``), bench capture (``BENCH_JOURNAL``),
+  the evidence pipeline (``capture_evidence.py`` step journal) and the
+  train CLI (checkpoint-every-N + last-good rollback).
+- ``supervisor`` — the elastic layer over the in-graph sentinel: forwards
+  compiled with per-stage digest taps inside their shard_map bodies, a
+  trip (``stage_digest``/``shard_divergence``/``device_loss``) re-plans
+  down a degradation ladder (fewer shards → replicated → reference) and
+  replays the batch, journaling every transition (run ``--supervise``,
+  harness ``SupervisorMsg`` column).
 
 Wired through ``harness`` (DEGRADED triage + wedge-aware re-capture +
 journaled ``--resume``), ``parallel.deploy`` (retrying transports + quorum
@@ -32,10 +39,11 @@ degradation + journaled host states), ``run``
 (``--checkpoint-every`` + sentinel rollback) and the bench capture
 scripts. See docs/RESILIENCE.md.
 
-``sentinel`` imports jax and is therefore NOT re-exported here — the
-stdlib-only consumers (harness, deploy, bench parent) import this package
-without paying a jax import; training-side callers import
-``resilience.sentinel`` directly.
+``sentinel`` and ``supervisor`` import jax and are therefore NOT
+re-exported here — the stdlib-only consumers (harness, deploy, bench
+parent) import this package without paying a jax import; training/serving
+callers import ``resilience.sentinel`` / ``resilience.supervisor``
+directly.
 """
 
 from .chaos import (

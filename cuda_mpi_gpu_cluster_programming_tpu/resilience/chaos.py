@@ -20,7 +20,14 @@ fires would report "recovery path exercised" without exercising anything):
 
     collective        run CLI build step (sharded strategies) — transient
                       collective/ICI failure.
-    device_loss       run CLI build step — mesh shrink (needs N, have M).
+    device_loss       run CLI build step AND resilience.supervisor — mesh
+                      shrink (needs N, have M); the supervisor treats it as
+                      an SDC(device_loss) and re-plans down its ladder.
+    stage_sdc         resilience.supervisor digest screening — a seeded
+                      stage of the in-graph digest tree is corrupted to NaN
+                      before screening, so the StageDigests checker must
+                      trip stage_digest and the supervisor must degrade,
+                      replay the batch, and match the uninjected oracle.
     kernel_compile    run CLI build step (pallas tier) — Mosaic lowering
                       failure; degrades Pallas -> XLA reference tier.
     subprocess_wedge  harness.run_case — the classic wedged-tunnel capture
@@ -58,6 +65,7 @@ KNOWN_SITES = (
     "rsync",
     "sdc",
     "nan_loss",
+    "stage_sdc",
 )
 
 

@@ -19,6 +19,12 @@ committing garbage steps:
   shard_map: pmax - pmin of per-shard digests, the psum-agreement test) and
   ``replicated_shard_spread`` (host-side: per-device buffers of a
   replicated leaf must be bit-identical across addressable shards).
+- **In-graph stage digests** — the sharded/tp/sequence-parallel forwards
+  can compile per-stage activation digest taps INSIDE their shard_map
+  bodies (``with_digests=True``); :class:`StageDigests` screens the
+  returned digest tree host-side, strictly off the timed path (the
+  :func:`off_timed_path` annotation marks — and staticcheck enforces —
+  that screening never runs inside a timed loop).
 - **Golden-oracle spot checks** — ``oracle_spot_check`` periodically re-runs
   a tiny conv through the framework op stack against the hand-written numpy
   oracle in ``tests/oracle.py``; a mismatch means the compute stack itself
@@ -51,7 +57,12 @@ class SDC(RuntimeError):
     """A detected silent-data-corruption event: structured (kind, step,
     detail) so quarantine policy and fault logs can triage without string
     matching. Kinds: ``nan_loss``, ``nonfinite``, ``norm_spike``,
-    ``replica_divergence``, ``oracle_mismatch``."""
+    ``replica_divergence``, ``oracle_mismatch``, plus the in-graph /
+    supervisor family: ``stage_digest`` (a per-stage activation digest from
+    inside a shard_map forward is non-finite or deviates from its
+    reference), ``shard_divergence`` (shards that should hold identical
+    values digest differently), ``device_loss`` (a device/shard vanished
+    mid-fleet; the supervisor re-plans down its ladder)."""
 
     def __init__(self, kind: str, step: int, detail: str = ""):
         super().__init__(
@@ -60,6 +71,20 @@ class SDC(RuntimeError):
         self.kind = kind
         self.step = step
         self.detail = detail
+
+
+def off_timed_path(fn):
+    """Annotate a function as NEVER called inside a timed region.
+
+    Identity decorator, but statically meaningful: the staticcheck
+    ``host-sync-in-hot-loop`` rule exempts loops/syncs inside functions
+    carrying it (digest screening and oracle spot checks are host round
+    trips BY DESIGN — the contract is that they run between timed regions,
+    not that they avoid syncs). Decorating a function that IS on a timed
+    path defeats the gate; treat the decorator like a ``# noqa`` with a
+    wider span and the same review bar."""
+    fn.__off_timed_path__ = True
+    return fn
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +186,7 @@ class Sentinel:
             )
         return spread
 
+    @off_timed_path
     def oracle_check(self, step: int) -> None:
         """Golden-oracle spot check (tests/oracle.py): a tiny conv through
         the framework op must match the hand-written numpy loops. A
@@ -174,6 +200,87 @@ class Sentinel:
                 f"framework conv deviates from numpy oracle by {err:.3e} "
                 f"(tol {self.cfg.oracle_tol:g})",
             )
+
+
+class StageDigests:
+    """Screen the auxiliary digest tree an in-graph-tapped forward returns.
+
+    The sharded/tp/sequence-parallel builders (``with_digests=True``)
+    compile one activation digest per pipeline stage INSIDE the shard_map
+    body — a per-shard scalar riding alongside the output, so taps cost no
+    host sync in the hot loop. ``check`` pulls those device scalars ONCE,
+    between timed regions, and raises :class:`SDC` when:
+
+    - any stage digest is non-finite (``stage_digest``): a NaN/Inf anywhere
+      in a stage's activations poisons its digest, so corruption inside the
+      shard_map is visible without materializing the activations;
+    - ``expect`` is given and a stage's digest vector deviates from the
+      recorded reference beyond ``rtol`` (``stage_digest``): the replay /
+      golden-reference comparison the supervisor uses after a re-plan;
+    - ``replicated=True`` and the per-shard digests of a stage disagree
+      beyond ``divergence_tol`` (``shard_divergence``): shards holding the
+      SAME logical values (replicated tiers, dp replicas) must digest
+      bit-identically.
+
+    ``check`` returns ``{stage: np.ndarray}`` (the host copies) so callers
+    can journal or diff them without a second device fetch.
+    """
+
+    def __init__(self, cfg: SentinelConfig = SentinelConfig(), site: str = "forward"):
+        self.cfg = cfg
+        self.site = site
+        self.trips: List[SDC] = []
+        self.last: Dict[str, np.ndarray] = {}
+
+    def _trip(self, kind: str, step: int, detail: str) -> None:
+        e = SDC(kind, step, detail)
+        self.trips.append(e)
+        raise e
+
+    @off_timed_path
+    def check(
+        self,
+        step: int,
+        digests,
+        replicated: bool = False,
+        expect: Optional[Dict[str, np.ndarray]] = None,
+        rtol: float = 0.0,
+    ) -> Dict[str, np.ndarray]:
+        host: Dict[str, np.ndarray] = {}
+        for stage in sorted(digests):
+            vec = np.asarray(digests[stage], np.float64).reshape(-1)
+            host[stage] = vec
+            if not np.all(np.isfinite(vec)):
+                self._trip(
+                    "stage_digest",
+                    step,
+                    f"{self.site}/{stage}: non-finite stage digest {vec.tolist()}",
+                )
+            if replicated and vec.size > 1:
+                spread = float(vec.max() - vec.min())
+                if spread > self.cfg.divergence_tol:
+                    self._trip(
+                        "shard_divergence",
+                        step,
+                        f"{self.site}/{stage}: per-shard digest spread "
+                        f"{spread:.6e} > tol {self.cfg.divergence_tol:g}",
+                    )
+            if expect is not None and stage in expect:
+                want = np.asarray(expect[stage], np.float64).reshape(-1)
+                scale = max(float(np.max(np.abs(want))) if want.size else 0.0, 1e-12)
+                err = (
+                    float(np.max(np.abs(vec - want))) if vec.shape == want.shape
+                    else float("inf")
+                )
+                if err > rtol * scale:
+                    self._trip(
+                        "stage_digest",
+                        step,
+                        f"{self.site}/{stage}: digest deviates from reference "
+                        f"by {err:.6e} (rtol {rtol:g}, scale {scale:.3e})",
+                    )
+        self.last = host
+        return host
 
 
 # ------------------------------------------------------------- digests ---

@@ -1,0 +1,363 @@
+"""Elastic supervisor: in-graph sentinel screening + degradation-ladder
+re-planning for any built forward.
+
+PR 1's ``Degrader`` walks a fallback chain at BUILD time (a tier that fails
+to compile falls to the next); PR 3's ``Sentinel`` screens the host-side
+training loop. Neither sees a bit flip, a diverged replica, or a lost chip
+*inside* a sharded forward mid-fleet. The supervisor closes that gap:
+
+- every ladder entry builds its forward with the in-graph digest taps
+  (``with_digests=True`` — per-stage ``tree_digest`` scalars compiled
+  inside the shard_map bodies of ``parallel.sharded`` /
+  ``parallel.tensor_parallel``), so screening costs zero host syncs in the
+  hot loop — the digests are device scalars riding beside the output;
+- :meth:`Supervisor.execute` runs a batch, then screens the digest tree
+  host-side via :class:`~.sentinel.StageDigests`, strictly OFF the timed
+  path (:func:`~.sentinel.off_timed_path` marks it; staticcheck's
+  ``host-sync-in-hot-loop`` rule enforces it);
+- a trip — ``stage_digest``, ``shard_divergence``, or ``device_loss`` —
+  re-plans to the next entry of the degradation ladder (fewer shards →
+  replicated → single-device reference), re-executes the SAME batch on the
+  new plan, and journals every transition (``sup_trip`` / ``sup_degrade``
+  / ``sup_ok`` records via ``resilience.journal``), reusing PR 1's
+  ``DegradedEvent`` vocabulary so harness triage needs no new grammar;
+- the single-device floor builds through ``configs.build_forward`` so a
+  PR 2 tuning plan keeps its env > plan > default precedence on the way
+  down the ladder.
+
+Every recovery path is drillable on CPU: ``CHAOS_SPEC="stage_sdc=1"``
+corrupts a seeded stage digest before screening, ``device_loss=1`` raises
+the mesh-shrink signature before the forward runs (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import chaos
+from .journal import Journal
+from .policy import DegradationExhausted, DegradedEvent
+from .sentinel import (
+    SDC,
+    SentinelConfig,
+    StageDigests,
+    off_timed_path,
+    replicated_shard_spread,
+)
+
+# Mesh-shrink signatures a real device loss surfaces as (jax raises plain
+# RuntimeError/ValueError quoting device counts; chaos mimics the same
+# message so triage sees one grammar).
+_DEVICE_LOSS_MARKERS = ("device_loss", "devices, have", "), have ")
+
+
+@dataclasses.dataclass(frozen=True)
+class LadderEntry:
+    """One rung of the degradation ladder: how to build the forward."""
+
+    strategy: str  # "halo" | "staged_halo" | "tp" | "replicated" | "single"
+    tier: str = "reference"  # "reference" | "pallas"
+    n_shards: int = 1
+
+    @property
+    def key(self) -> str:
+        return f"{self.strategy}@{self.n_shards}:{self.tier}"
+
+
+def default_ladder(strategy: str, tier: str, n_shards: int) -> List[LadderEntry]:
+    """The canonical recovery ladder for a (strategy, tier, shards) point:
+    the requested plan, then the same strategy at halved shard counts (a
+    lost chip shrinks the mesh), then replicate-all (every device redundant
+    — survives any single-shard divergence), then the single-device
+    reference floor that is always buildable. Mirrors
+    ``policy.tier_fallback_chain`` but over SHARD topology rather than
+    config keys, which is what a mid-fleet device loss actually changes."""
+    entries: List[LadderEntry] = []
+    if strategy in ("halo", "staged_halo", "tp"):
+        n = n_shards
+        while n >= 2:
+            entries.append(LadderEntry(strategy, tier, n))
+            n //= 2
+        if n_shards >= 2:
+            entries.append(LadderEntry("replicated", "reference", n_shards))
+    elif strategy == "replicated":
+        entries.append(LadderEntry("replicated", "reference", max(1, n_shards)))
+    elif strategy == "single":
+        if tier != "reference":
+            entries.append(LadderEntry("single", tier, 1))
+    else:
+        raise ValueError(f"no supervisor ladder for strategy {strategy!r}")
+    entries.append(LadderEntry("single", "reference", 1))
+    return entries
+
+
+def _is_device_loss(e: BaseException) -> bool:
+    msg = str(e)
+    return isinstance(e, (RuntimeError, ValueError, chaos.InjectedFault)) and any(
+        m in msg for m in _DEVICE_LOSS_MARKERS
+    )
+
+
+class Supervisor:
+    """Wrap a degradation ladder of digest-tapped forwards with trip
+    handling. ``execute(params, x)`` always returns the batch's output from
+    SOME rung (or raises :class:`DegradationExhausted` when every rung is
+    spent); ``attempts``/``trips``/``events`` carry the incident trail the
+    CLIs surface the way PR 1's resilience columns do."""
+
+    def __init__(
+        self,
+        model_cfg,
+        ladder: List[LadderEntry],
+        *,
+        plan=None,
+        sentinel_cfg: SentinelConfig = SentinelConfig(),
+        journal: Optional[Journal] = None,
+        on_event: Optional[Callable[[DegradedEvent], None]] = None,
+        site: str = "supervisor",
+    ):
+        if not ladder:
+            raise ValueError("Supervisor needs a non-empty ladder")
+        self.model_cfg = model_cfg
+        self.ladder = list(ladder)
+        self.plan = plan
+        self.journal = journal
+        self.on_event = on_event
+        self.site = site
+        self.checker = StageDigests(sentinel_cfg, site=site)
+        self.trips: List[SDC] = []
+        self.events: List[DegradedEvent] = []
+        self.attempts = 0
+        self.compile_ms: Optional[float] = None
+        self._idx = 0
+        self._fwd: Optional[Callable] = None
+        self._step = 0
+
+    # ------------------------------------------------------------ building
+
+    @property
+    def entry(self) -> LadderEntry:
+        return self.ladder[self._idx]
+
+    def _journal(self, kind: str, key: str, **payload) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, key=key, **payload)
+
+    def _build_entry(self, entry: LadderEntry) -> Callable:
+        cfg = self.model_cfg
+        if entry.strategy in ("halo", "staged_halo"):
+            from ..parallel.sharded import build_sharded_forward
+
+            return build_sharded_forward(
+                cfg,
+                entry.n_shards,
+                tier=entry.tier,
+                staged=(entry.strategy == "staged_halo"),
+                with_digests=True,
+            )
+        if entry.strategy == "tp":
+            from ..parallel.tensor_parallel import build_tp_forward
+
+            return build_tp_forward(cfg, entry.n_shards, with_digests=True)
+        if entry.strategy == "replicated":
+            from ..parallel.replicated import build_replicated_forward
+
+            return self._wrap_digest(build_replicated_forward(cfg, entry.n_shards))
+        if entry.strategy == "single":
+            # Through configs.build_forward so a PR 2 TunePlan keeps its
+            # env > plan > default variant precedence on the pallas floor.
+            from ..configs import REGISTRY, build_forward
+
+            key = "v3_pallas" if entry.tier == "pallas" else "v1_jit"
+            return self._wrap_digest(
+                build_forward(REGISTRY[key], cfg, plan=self.plan)
+            )
+        raise ValueError(f"unknown ladder strategy {entry.strategy!r}")
+
+    @staticmethod
+    def _wrap_digest(base: Callable) -> Callable:
+        """Output-digest tap for tiers without an in-body shard_map tap."""
+        import jax
+
+        from .sentinel import tree_digest
+
+        @jax.jit
+        def fwd(p, x):
+            out = base(p, x)
+            return out, {"out": tree_digest(out)[None]}
+
+        return fwd
+
+    def fwd(self) -> Callable:
+        """The current rung's compiled ``(params, x) -> (out, digests)`` —
+        what a timing harness should measure (taps included, no host
+        syncs). Builds lazily on first use."""
+        if self._fwd is None:
+            self._fwd = self._build_entry(self.entry)
+            self._journal("sup_build", key=self.entry.key, entry=self.entry.key)
+        return self._fwd
+
+    # ----------------------------------------------------------- execution
+
+    def _maybe_chaos_device_loss(self, entry: LadderEntry) -> None:
+        ch = chaos.active()
+        if ch is None or entry.n_shards <= 1:
+            return
+        if ch.draw("device_loss"):
+            raise chaos.InjectedFault(
+                "device_loss",
+                f"entry {entry.key} needs {entry.n_shards} devices, have "
+                f"{entry.n_shards - 1}",
+            )
+
+    def _maybe_chaos_stage_sdc(self, digests: Dict) -> Dict:
+        ch = chaos.active()
+        if ch is None or not digests:
+            return digests
+        if ch.draw("stage_sdc"):
+            stages = sorted(digests)
+            pick = random.Random(f"{ch.spec.seed}:stage_sdc").choice(stages)
+            corrupt = dict(digests)
+            corrupt[pick] = np.full_like(
+                np.asarray(digests[pick], np.float64), np.nan
+            )
+            return corrupt
+        return digests
+
+    @off_timed_path
+    def _screen(self, out, digests) -> None:
+        """Host-side digest screening — between timed regions by contract
+        (the off_timed_path annotation is what staticcheck checks)."""
+        entry = self.entry
+        digests = self._maybe_chaos_stage_sdc(digests)
+        self.checker.check(
+            self._step, digests, replicated=(entry.strategy == "replicated")
+        )
+        if entry.strategy == "replicated":
+            # Replicated buffers must be bit-identical across shards —
+            # PR 3's host-side checksum, reused as the cross-shard compare.
+            spread = replicated_shard_spread(out)
+            if spread > self.checker.cfg.divergence_tol:
+                raise SDC(
+                    "shard_divergence",
+                    self._step,
+                    f"{self.site}/{entry.key}: replicated output spread "
+                    f"{spread:.6e} > tol {self.checker.cfg.divergence_tol:g}",
+                )
+
+    def _advance(self, cause: str, last: BaseException):
+        """Move to the next buildable rung, journaling each DEGRADED hop."""
+        while True:
+            if self._idx + 1 >= len(self.ladder):
+                raise DegradationExhausted(
+                    [e.key for e in self.ladder], self.events, last
+                ) from last
+            ev = DegradedEvent(
+                self.ladder[self._idx].key, self.ladder[self._idx + 1].key, cause
+            )
+            self.events.append(ev)
+            if self.on_event is not None:
+                self.on_event(ev)
+            self._journal(
+                "sup_degrade",
+                key=f"degrade:{len(self.events)}",
+                frm=ev.from_tier,
+                to=ev.to_tier,
+                cause=ev.cause,
+            )
+            self._idx += 1
+            self._fwd = None
+            try:
+                self.fwd()  # build eagerly: an unbuildable rung degrades again
+                return
+            except Exception as e:  # noqa — next hop carries the cause
+                last = e
+                cause = f"build failed: {type(e).__name__}: {e}"[:200]
+
+    @off_timed_path
+    def execute(self, params, x, step: Optional[int] = None):
+        """Run one batch with screening + trip handling; returns ``out``.
+
+        On a trip the failed batch is REPLAYED on the next rung — callers
+        never see a half-screened result. Bounded by the ladder length
+        (each rung gets one attempt per incident; a rung that keeps
+        tripping keeps degrading until the floor, then
+        :class:`DegradationExhausted` propagates).
+        """
+        import jax
+
+        if step is not None:
+            self._step = step
+        while True:
+            self.attempts += 1
+            entry = self.entry
+            try:
+                fwd = self.fwd()
+            except Exception as e:  # noqa — unbuildable rung: degrade, as
+                # PR 1's Degrader does for a chain tier that fails to build.
+                self._advance(f"build failed: {type(e).__name__}: {e}"[:200], e)
+                continue
+            try:
+                self._maybe_chaos_device_loss(entry)
+                t0 = time.perf_counter()
+                out, digests = fwd(params, x)
+                jax.block_until_ready(out)
+                if self.compile_ms is None:
+                    self.compile_ms = (time.perf_counter() - t0) * 1e3
+                self._screen(out, digests)
+            except SDC as e:
+                self.trips.append(e)
+                self._journal(
+                    "sup_trip",
+                    key=f"trip:{len(self.trips)}",
+                    sdc_kind=e.kind,
+                    step=e.step,
+                    entry=entry.key,
+                    cause=str(e)[:200],
+                )
+                self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
+                continue
+            except Exception as e:  # noqa — classified below
+                if not _is_device_loss(e):
+                    raise
+                sdc = SDC("device_loss", self._step, str(e)[:200])
+                self.trips.append(sdc)
+                self._journal(
+                    "sup_trip",
+                    key=f"trip:{len(self.trips)}",
+                    sdc_kind="device_loss",
+                    step=self._step,
+                    entry=entry.key,
+                    cause=str(e)[:200],
+                )
+                self._advance(f"SDC(device_loss): {e}"[:200], sdc)
+                continue
+            self._journal(
+                "sup_ok",
+                key=f"ok:{self._step}",
+                entry=self.entry.key,
+                attempts=self.attempts,
+            )
+            self._step += 1
+            return out
+
+    # ------------------------------------------------------------ surfacing
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.events)
+
+    def summary(self) -> str:
+        """One machine-parseable line for the run CLI ('Supervisor: ...' —
+        harness._RE_SUPERVISOR greps it into the SupervisorMsg CSV col)."""
+        kinds = ",".join(t.kind for t in self.trips) or "none"
+        return (
+            f"attempts={self.attempts} trips={len(self.trips)} "
+            f"degradations={len(self.events)} entry={self.entry.key} "
+            f"kinds={kinds}"
+        )

@@ -122,6 +122,22 @@ def make_parser() -> argparse.ArgumentParser:
         "from it; explicit TPU_FRAMEWORK_* env knobs still win "
         "(docs/TUNING.md)",
     )
+    p.add_argument(
+        "--supervise",
+        action="store_true",
+        help="run under the elastic supervisor: the forward compiles with "
+        "in-graph per-stage digest taps, every batch is screened off the "
+        "timed path, and a trip (stage_digest / shard_divergence / "
+        "device_loss) degrades down the shard ladder and replays the batch "
+        "(docs/RESILIENCE.md). Blocks 1-2 configs only; prints one "
+        "machine-parsed 'Supervisor: ...' line",
+    )
+    p.add_argument(
+        "--supervisor-journal",
+        default="",
+        help="with --supervise: journal every build/trip/degrade/ok "
+        "transition to this jsonl path (resilience.journal format)",
+    )
     return p
 
 
@@ -341,7 +357,48 @@ def main(argv=None) -> int:
         or args.deadline_s > 0
         or chaos.active() is not None
     )
-    if not resilient:
+    sup = None
+    if args.supervise:
+        # Elastic supervisor: digest-tapped forward + screening + ladder
+        # re-planning. It owns building (and its own chaos draws), so the
+        # retry/degrader build path below is bypassed.
+        if exec_cfg.model != "blocks12":
+            print("--supervise supports the Blocks 1-2 configs only", file=sys.stderr)
+            return 2
+        if args.fallback_chain:
+            print(
+                "--supervise has its own degradation ladder; drop --fallback-chain",
+                file=sys.stderr,
+            )
+            return 2
+        from .resilience.journal import Journal
+        from .resilience.policy import DegradationExhausted
+        from .resilience.supervisor import Supervisor, default_ladder
+
+        try:
+            ladder = default_ladder(exec_cfg.strategy, exec_cfg.tier, args.shards)
+        except ValueError as e:
+            print(f"cannot supervise config {exec_cfg.key!r}: {e}", file=sys.stderr)
+            return 2
+        sup = Supervisor(
+            model_cfg,
+            ladder,
+            plan=plan,
+            journal=(
+                Journal(args.supervisor_journal) if args.supervisor_journal else None
+            ),
+            # DEGRADED events print to stdout where the harness greps them,
+            # exactly like the build-time Degrader's.
+            on_event=lambda ev: print(ev, flush=True),
+        )
+        try:
+            sup.execute(params, x)
+        except DegradationExhausted as e:
+            print(f"supervisor: every ladder rung failed: {e.last}", file=sys.stderr)
+            return 2
+        fwd = sup.fwd()  # (params, x) -> (out, digests): taps ride the timed path
+        compile_ms = sup.compile_ms or 0.0
+    elif not resilient:
         # Historical fast path, byte-identical stdout/stderr.
         try:
             fwd = build_forward(
@@ -401,7 +458,13 @@ def main(argv=None) -> int:
         per_pass_ms = st.per_call_ms
     if args.profile:
         print(f"Profiler trace written to {args.profile}")
-    out = np.asarray(fwd(params, x))
+    if sup is not None:
+        # Screened verification pass (digest screening off the timed path),
+        # then the machine-parsed supervisor line for the harness CSV.
+        out = np.asarray(sup.execute(params, x))
+        print(f"Supervisor: {sup.summary()}")
+    else:
+        out = np.asarray(fwd(params, x))
 
     shape_str = "x".join(str(d) for d in out.shape[1:])
     flat = out[0].reshape(-1)

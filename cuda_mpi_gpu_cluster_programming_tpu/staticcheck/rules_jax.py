@@ -18,10 +18,17 @@ gate that cries wolf gets ``# noqa``'d into uselessness.
   host-sync-in-hot-loop — .item()/np.asarray/jax.device_get/
                          block_until_ready inside for/while bodies of the
                          measurement surfaces (bench.py, harness.py,
-                         training.py); float(...) too when the loop is a
-                         timed region (its body calls time.monotonic/
-                         perf_counter/time). Each one is a device round-trip
-                         inside the loop being timed.
+                         training.py, run.py, resilience/supervisor.py);
+                         float(...) too when the loop is a timed region
+                         (its body calls time.monotonic/perf_counter/time).
+                         Each one is a device round-trip inside the loop
+                         being timed. EXEMPT: anything inside a function
+                         decorated ``@off_timed_path``
+                         (resilience.sentinel) — sentinel/digest screening
+                         is a host round trip BY DESIGN and contractually
+                         runs between timed regions, not inside them; the
+                         decorator is the statically-checkable form of that
+                         contract (same review bar as a # noqa).
   key-reuse            — the same PRNG key expression consumed by two
                          jax.random draws with no intervening split/fold_in
                          rebinding (same scope), or a loop-invariant key
@@ -242,8 +249,26 @@ class UnreducedContractionRule(Rule):
 # host-sync-in-hot-loop
 
 
-_HOT_LOOP_FILES = {"bench.py", "harness.py", "training.py"}
+_HOT_LOOP_FILES = {"bench.py", "harness.py", "training.py", "run.py", "supervisor.py"}
 _TIME_CALLS = {"monotonic", "perf_counter", "time", "process_time"}
+_OFF_TIMED_PATH_DECORATOR = "off_timed_path"
+
+
+def _off_timed_path_spans(tree: ast.AST):
+    """Line spans of functions decorated ``@off_timed_path`` — the
+    statically-visible 'never called inside a timed region' contract
+    (resilience.sentinel.off_timed_path). Sync findings inside them are
+    exempt: screening/oracle checks are host round trips by design."""
+    spans = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _terminal_attr(target) == _OFF_TIMED_PATH_DECORATOR:
+                spans.append((node.lineno, getattr(node, "end_lineno", node.lineno)))
+                break
+    return spans
 
 
 def _loop_is_timed(loop: ast.AST) -> bool:
@@ -279,24 +304,30 @@ class HostSyncInHotLoopRule(Rule):
 
     def check(self, ctx: FileContext) -> List[Finding]:
         out = []
+        exempt = _off_timed_path_spans(ctx.tree)
         for loop in ast.walk(ctx.tree):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
             timed = _loop_is_timed(loop)
             for node in _iter_loop_body(loop):
                 what = self._sync_kind(node, timed)
-                if what is not None:
-                    out.append(
-                        self.finding(
-                            ctx, node.lineno,
-                            f"{what} inside a {'timed ' if timed else ''}"
-                            "for/while body is a host<->device sync per "
-                            "iteration — hoist it out of the loop or batch "
-                            "the transfer (deliberate sites: "
-                            "# noqa: host-sync-in-hot-loop)",
-                            span=(node.lineno, getattr(node, "end_lineno", node.lineno)),
-                        )
+                if what is None:
+                    continue
+                if any(a <= node.lineno <= b for a, b in exempt):
+                    continue  # @off_timed_path: screening by contract
+                out.append(
+                    self.finding(
+                        ctx, node.lineno,
+                        f"{what} inside a {'timed ' if timed else ''}"
+                        "for/while body is a host<->device sync per "
+                        "iteration — hoist it out of the loop or batch "
+                        "the transfer (deliberate sites: "
+                        "# noqa: host-sync-in-hot-loop, or mark the whole "
+                        "function @off_timed_path when it contractually "
+                        "runs between timed regions)",
+                        span=(node.lineno, getattr(node, "end_lineno", node.lineno)),
                     )
+                )
         return out
 
     @staticmethod

@@ -71,6 +71,16 @@ def make_parser() -> argparse.ArgumentParser:
         "+ crash-consistent journal.jsonl",
     )
     p.add_argument(
+        "--checkpoint-shards",
+        type=int,
+        default=0,
+        help="with --checkpoint-every: save the training state as a "
+        "crash-consistent SHARDED tree (N atomic shard files + a "
+        "manifest-last commit, utils.checkpoint.save_train_state_sharded) "
+        "instead of one npz; a kill mid-save always leaves the last-good "
+        "generation loadable (0 = single-file npz, the historical format)",
+    )
+    p.add_argument(
         "--max-rollbacks",
         type=int,
         default=2,
@@ -107,24 +117,25 @@ def make_parser() -> argparse.ArgumentParser:
 
 
 def _run_resilient_loop(
-    args, jr, ckpt_path, start_step, get_batch, teacher_fwd, teacher,
+    args, jr, save_state, load_state, start_step, get_batch, teacher_fwd, teacher,
     step_fn, student, opt_state, sentinel, mesh, flog,
 ):
     """The quarantine-capable training loop (``--checkpoint-every`` > 0).
 
     Every committed step is journaled; every N-th commit atomically
-    checkpoints (params, opt_state, step) as the last-good state. A
+    checkpoints (params, opt_state, step) as the last-good state via
+    ``save_state`` (single-npz or sharded-tree, per --checkpoint-shards). A
     sentinel :class:`~..resilience.sentinel.SDC` trip rolls the loop back
-    to that state and re-enters (the chaos ``sdc``/``nan_loss`` drills
-    exercise exactly this path on CPU); ``--max-rollbacks`` consecutive
-    trips without a successful checkpoint abort with rc 3. Returns either
-    an exit code (int) or ``(first_loss, last_loss, steps_run)``.
+    to that state (``load_state``) and re-enters (the chaos
+    ``sdc``/``nan_loss`` drills exercise exactly this path on CPU);
+    ``--max-rollbacks`` consecutive trips without a successful checkpoint
+    abort with rc 3. Returns either an exit code (int) or
+    ``(first_loss, last_loss, steps_run)``.
     """
     import jax
 
     from .resilience import chaos
     from .resilience.sentinel import SDC
-    from .utils.checkpoint import load_train_state, save_train_state
 
     first = last = None
     last_good_step = start_step
@@ -176,7 +187,7 @@ def _run_resilient_loop(
                     file=sys.stderr,
                 )
                 return 3
-            student, opt_state, _ = load_train_state(ckpt_path, student, opt_state)
+            student, opt_state, _ = load_state(student, opt_state)
             i = last_good_step
             continue
         student, opt_state = new_student, new_opt
@@ -188,7 +199,7 @@ def _run_resilient_loop(
         jr.append("step", key=f"step:{i + 1}", step=i + 1, loss=loss)
         i += 1
         if i % args.checkpoint_every == 0 or i == args.steps:
-            save_train_state(ckpt_path, student, opt_state, i)
+            save_state(student, opt_state, i)
             jr.append("ckpt", key=f"ckpt:{i}", step=i)
             last_good_step = i
             rollbacks = 0  # progress made: reset the consecutive-trip budget
@@ -289,23 +300,51 @@ def main(argv=None) -> int:
     from .resilience.policy import FaultLog
 
     jr = None
-    ckpt_path = None
+    save_state = load_state = None
     start_step = 0
     if resilient:
         from pathlib import Path
 
         from .resilience.journal import Journal
-        from .utils.checkpoint import load_train_state, save_train_state
 
         work = Path(args.work_dir)
         work.mkdir(parents=True, exist_ok=True)
-        ckpt_path = work / "ckpt_last_good.npz"
         jr = Journal(work / "journal.jsonl")
-        if ckpt_path.exists():
-            try:
-                student, opt_state, start_step = load_train_state(
-                    ckpt_path, student, opt_state
+        if args.checkpoint_shards > 0:
+            # Crash-consistent sharded tree: N atomic shard files, manifest
+            # committed last — a kill mid-save leaves the previous
+            # generation loadable (docs/RESILIENCE.md).
+            from .utils.checkpoint import (
+                MANIFEST_NAME,
+                load_train_state_sharded,
+                save_train_state_sharded,
+            )
+
+            ckpt_path = work / "ckpt_last_good"
+            ckpt_exists = (ckpt_path / MANIFEST_NAME).exists()
+
+            def save_state(p, o, s):
+                return save_train_state_sharded(
+                    ckpt_path, p, o, s, n_shards=args.checkpoint_shards
                 )
+
+            def load_state(lp, lo):
+                return load_train_state_sharded(ckpt_path, lp, lo)
+        else:
+            from .utils.checkpoint import load_train_state, save_train_state
+
+            ckpt_path = work / "ckpt_last_good.npz"
+            ckpt_exists = ckpt_path.exists()
+
+            def save_state(p, o, s):
+                return save_train_state(ckpt_path, p, o, s)
+
+            def load_state(lp, lo):
+                return load_train_state(ckpt_path, lp, lo)
+
+        if ckpt_exists:
+            try:
+                student, opt_state, start_step = load_state(student, opt_state)
                 print(f"Resumed training state from {ckpt_path} at step {start_step}")
                 jr.append("resume", key=f"resume:{start_step}", step=start_step)
             except (ValueError, KeyError) as e:
@@ -316,7 +355,7 @@ def main(argv=None) -> int:
         if start_step == 0:
             # The rollback target must exist BEFORE the first step so a trip
             # at step 1 has a last-good state to quarantine back to.
-            save_train_state(ckpt_path, student, opt_state, 0)
+            save_state(student, opt_state, 0)
             jr.append("ckpt", key="ckpt:0", step=0)
 
     first = last = None
@@ -335,8 +374,9 @@ def main(argv=None) -> int:
             return native.fill_batch(shape, "uniform", native.batch_seed(args.seed, k))
 
         rc = _run_resilient_loop(
-            args, jr, ckpt_path, start_step, get_batch, teacher_fwd, teacher,
-            step_fn, student, opt_state, sentinel, mesh, FaultLog(site="train-sentinel"),
+            args, jr, save_state, load_state, start_step, get_batch, teacher_fwd,
+            teacher, step_fn, student, opt_state, sentinel, mesh,
+            FaultLog(site="train-sentinel"),
         )
         if isinstance(rc, int):
             return rc

@@ -6,12 +6,20 @@ numerics are not comparable across runs. Here weights are first-class
 artifacts: one file serves every tier (XLA reference ops, Pallas, sharded),
 making the cross-tier bit-exactness contract testable from disk.
 
-Two formats:
+Three formats:
 
 - **npz** — stdlib-fast flat archive for host-resident trees; keys are
   '/'-joined pytree paths.
-- **orbax** — for large / sharded trees; restores to the sharding of a
-  provided target tree (multi-host safe).
+- **sharded-tree** (``save_tree_sharded``/``load_tree_sharded``) — the
+  orbax-path discipline without the dependency: the flattened tree is
+  split across N shard files, each written tmp-write/fsync/rename, with a
+  generation-tagged filename; a ``MANIFEST.json`` naming the complete
+  shard set is atomically replaced LAST (the commit point), and stale
+  generations are garbage-collected only after the commit. A kill at ANY
+  instant therefore leaves the manifest pointing at a fully-written
+  generation — the last-good tree always loads.
+- **orbax** — for large / sharded device trees; restores to the sharding
+  of a provided target tree (multi-host safe).
 
 Crash consistency: every npz save goes through the resilience layer's
 atomic tmp-write + fsync + rename helper, so a kill mid-save leaves the
@@ -23,6 +31,8 @@ so rollback policy can catch one exception type.
 
 from __future__ import annotations
 
+import contextlib
+import json
 import zipfile
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
@@ -30,9 +40,11 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from ..resilience.journal import atomic_open
+from ..resilience.journal import atomic_open, atomic_write_text
 
 PyTree = Any
+
+MANIFEST_NAME = "MANIFEST.json"
 
 
 def _key_str(entry) -> str:
@@ -153,6 +165,158 @@ def load_train_state(
         "step": np.zeros((), np.int64),
     }
     tree = load_params_npz(path, as_jax=False, like=like)
+    params = jax.tree_util.tree_map(jax.numpy.asarray, tree["params"])
+    opt_state = jax.tree_util.tree_map(jax.numpy.asarray, tree["opt_state"])
+    return params, opt_state, int(tree["step"])
+
+
+# ------------------------------------------------- sharded-tree format ---
+
+
+def _read_manifest(directory: Path) -> dict:
+    mpath = directory / MANIFEST_NAME
+    if not mpath.exists():
+        raise FileNotFoundError(f"no {MANIFEST_NAME} in {directory}")
+    try:
+        manifest = json.loads(mpath.read_text())
+    except ValueError as e:
+        raise ValueError(
+            f"sharded checkpoint manifest {mpath} is corrupt ({e}); it was "
+            "not written by the atomic saver or the medium is failing"
+        ) from e
+    if not isinstance(manifest, dict) or not isinstance(manifest.get("files"), list):
+        raise ValueError(f"sharded checkpoint manifest {mpath} is malformed")
+    return manifest
+
+
+def save_tree_sharded(
+    directory: str | Path, tree: PyTree, n_shards: int = 4, meta: Optional[dict] = None
+) -> Path:
+    """Crash-consistent sharded save of a pytree into ``directory``.
+
+    The flattened tree's leaves are dealt round-robin across ``n_shards``
+    npz shard files (``shard_<k>.gen<g>.npz``), each written atomically;
+    the manifest naming exactly that file set is atomically replaced LAST.
+    The manifest replace is the single commit point: a kill before it
+    leaves the previous manifest naming the previous (still complete,
+    generation-tagged so never overwritten) shard set; a kill after it has
+    already committed the new complete set. Older generations are deleted
+    only post-commit (best-effort — stale files are harmless).
+    """
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(tree)
+    keys = sorted(flat)
+    n_shards = max(1, int(n_shards))
+    gen = 0
+    with contextlib.suppress(FileNotFoundError, ValueError):
+        gen = int(_read_manifest(directory).get("gen", -1)) + 1
+    files = []
+    for k in range(n_shards):
+        group = keys[k::n_shards]
+        fname = f"shard_{k:03d}.gen{gen:08d}.npz"
+        with atomic_open(directory / fname, "wb") as fh:
+            np.savez(fh, **{key: flat[key] for key in group})
+        files.append(fname)
+    atomic_write_text(
+        directory / MANIFEST_NAME,
+        json.dumps(
+            {
+                "version": 1,
+                "gen": gen,
+                "n_shards": n_shards,
+                "files": files,
+                "n_leaves": len(keys),
+                "meta": meta or {},
+            },
+            indent=2,
+        )
+        + "\n",
+    )
+    # Post-commit GC of superseded generations; a kill mid-GC only leaves
+    # unreferenced files behind.
+    tag = f".gen{gen:08d}.npz"
+    for old in directory.glob("shard_*.gen*.npz"):
+        if not old.name.endswith(tag):
+            with contextlib.suppress(OSError):
+                old.unlink()
+    return directory
+
+
+def load_tree_sharded(
+    directory: str | Path, as_jax: bool = True, like: Optional[PyTree] = None
+) -> Tuple[PyTree, dict]:
+    """Load the last-good sharded tree: ``(tree, meta)``.
+
+    Only files the manifest names are read — stale or half-written
+    generations are invisible. A missing/truncated shard file (a failing
+    medium; the saver cannot produce this state) raises the same uniform
+    ``ValueError`` the npz loader uses, so rollback policy catches one
+    exception type for both formats.
+    """
+    directory = Path(directory)
+    manifest = _read_manifest(directory)
+    flat: Dict[str, np.ndarray] = {}
+    for fname in manifest["files"]:
+        fpath = directory / fname
+        try:
+            with np.load(fpath) as archive:
+                for k in archive.files:
+                    flat[k] = archive[k]
+        except (zipfile.BadZipFile, EOFError, OSError) as e:
+            raise ValueError(
+                f"sharded checkpoint shard {fpath} is missing, truncated or "
+                f"corrupt ({type(e).__name__}: {e}); the manifest-commit "
+                "saver cannot produce this — suspect the medium"
+            ) from e
+    if manifest.get("n_leaves") not in (None, len(flat)):
+        raise ValueError(
+            f"sharded checkpoint {directory} holds {len(flat)} leaves, "
+            f"manifest promises {manifest['n_leaves']}"
+        )
+    if like is not None:
+        paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for path_keys, _ in paths:
+            key = "/".join(_key_str(p) for p in path_keys)
+            if key not in flat:
+                raise KeyError(f"sharded checkpoint {directory} has no leaf {key!r}")
+            leaves.append(flat[key])
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    else:
+        tree = _unflatten(flat)
+    if as_jax:
+        tree = jax.tree_util.tree_map(jax.numpy.asarray, tree)
+    return tree, manifest.get("meta", {})
+
+
+def save_train_state_sharded(
+    directory: str | Path, params: PyTree, opt_state: PyTree, step: int,
+    n_shards: int = 4,
+) -> Path:
+    """Sharded-tree twin of :func:`save_train_state` — the last-good state
+    the sentinel/supervisor rollback restores, for trees big enough that a
+    single monolithic npz write stretches the crash window."""
+    return save_tree_sharded(
+        directory,
+        {"params": params, "opt_state": opt_state, "step": np.asarray(step, np.int64)},
+        n_shards=n_shards,
+        meta={"step": int(step)},
+    )
+
+
+def load_train_state_sharded(
+    directory: str | Path, like_params: PyTree, like_opt_state: PyTree
+) -> Tuple[PyTree, PyTree, int]:
+    """Restore ``(params, opt_state, step)`` from a sharded-tree checkpoint
+    into exactly the provided structures (same contract and exception types
+    as :func:`load_train_state`)."""
+    like = {
+        "params": like_params,
+        "opt_state": like_opt_state,
+        "step": np.zeros((), np.int64),
+    }
+    tree, _meta = load_tree_sharded(directory, as_jax=False, like=like)
     params = jax.tree_util.tree_map(jax.numpy.asarray, tree["params"])
     opt_state = jax.tree_util.tree_map(jax.numpy.asarray, tree["opt_state"])
     return params, opt_state, int(tree["step"])

@@ -18,6 +18,14 @@ Steps (each bounded; a wedged tunnel fails fast, not forever):
   6. report    — analysis_exports/best_runs_report.md + view exports.
   7. plots     — combined TPU-vs-reference speedup/efficiency PNGs.
 
+Journal-driven resume: every step's terminal status is journaled to
+``<out-dir>/capture_journal.jsonl`` (``resilience.journal`` — fsync'd
+appends, torn-tail tolerant). A re-run with the same ``--out-dir`` skips
+journaled-OK steps and re-runs only failed/missing ones, so a capture
+killed mid-pipeline (the wedged-tunnel norm) costs one relaunch, not a
+from-scratch multi-hour sweep. The probe ALWAYS re-runs — a healed journal
+must not vouch for a re-wedged device. ``--fresh`` discards the journal.
+
 Artifacts to commit afterwards: logs/<session>/, perf/, plots/,
 analysis_exports/, BENCH JSON line (echoed).
 """
@@ -37,12 +45,35 @@ REFERENCE = Path("/root/reference")
 
 sys.path.insert(0, str(ROOT))
 from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import (  # noqa: E402
+    Journal,
     atomic_write_text,
 )
 from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe  # noqa: E402
 
+JOURNAL_NAME = "capture_journal.jsonl"
 
-def run(name: str, cmd, timeout_s: float, statuses: dict) -> subprocess.CompletedProcess | None:
+
+def step_done(completed: dict, name: str) -> bool:
+    """A step is journaled-complete when its LAST record says OK (an 'OK
+    (2 attempts)' retried-but-healed label still counts)."""
+    rec = completed.get(name)
+    return rec is not None and str(rec.get("status", "")).startswith("OK")
+
+
+def run(
+    name: str,
+    cmd,
+    timeout_s: float,
+    statuses: dict,
+    journal: Journal | None = None,
+    completed: dict | None = None,
+    commit: bool = True,
+) -> subprocess.CompletedProcess | None:
+    if completed and step_done(completed, name):
+        statuses[name] = completed[name]["status"]
+        print(f"\n=== {name}: journaled-complete ({statuses[name]}), skipped "
+              "— use --fresh to re-run")
+        return None
     print(f"\n=== {name}: {' '.join(map(str, cmd))}")
     t0 = time.perf_counter()
     try:
@@ -56,6 +87,8 @@ def run(name: str, cmd, timeout_s: float, statuses: dict) -> subprocess.Complete
     except subprocess.TimeoutExpired:
         print(f"--- {name}: TIMEOUT after {timeout_s:.0f}s")
         statuses[name] = "TIMEOUT"
+        if journal is not None and commit:
+            journal.append("step", key=name, status="TIMEOUT")
         return None
     wall = time.perf_counter() - t0
     sys.stdout.write(proc.stdout[-4000:])
@@ -63,6 +96,11 @@ def run(name: str, cmd, timeout_s: float, statuses: dict) -> subprocess.Complete
         sys.stdout.write((proc.stderr or "")[-2000:])
     statuses[name] = "OK" if proc.returncode == 0 else f"rc={proc.returncode}"
     print(f"--- {name}: {statuses[name]} ({wall:.1f}s)")
+    # Steps whose status needs post-processing (bench: the parsed JSON
+    # verdict outranks the exit code) pass commit=False and journal
+    # themselves once their real status is known.
+    if journal is not None and commit:
+        journal.append("step", key=name, status=statuses[name], rc=proc.returncode)
     return proc
 
 
@@ -79,15 +117,45 @@ def main() -> int:
         "warehouse run_stats CIs get n>=N samples per cell — the reference's "
         "n=15-59 stats.csv cells need repeated sessions, not one big one",
     )
+    ap.add_argument(
+        "--out-dir",
+        default="logs",
+        help="directory holding the step journal (capture_journal.jsonl); a "
+        "re-run with the same out-dir resumes, skipping journaled-OK steps",
+    )
+    ap.add_argument(
+        "--fresh",
+        action="store_true",
+        help="discard the step journal: re-run every step from scratch",
+    )
     args = ap.parse_args()
     args.sessions = max(1, args.sessions)  # 0/negative: still one session
     statuses: dict = {}
     py = sys.executable
 
+    import functools
+
+    out_dir = Path(args.out_dir)
+    if not out_dir.is_absolute():
+        out_dir = ROOT / out_dir
+    jpath = out_dir / JOURNAL_NAME
+    if args.fresh and jpath.exists():
+        jpath.unlink()
+    completed = Journal.completed(Journal.load(jpath), "step")
+    if completed:
+        done = sorted(k for k in completed if step_done(completed, k))
+        print(f"resuming from {jpath}: {len(done)} journaled-OK step(s) will "
+              f"be skipped ({', '.join(done)})")
+    journal = Journal(jpath)
+    run_j = functools.partial(run, journal=journal, completed=completed)
+
     # 1. Bounded probe — refuse to start a multi-hour capture on a wedge.
+    #    ALWAYS re-probed, journal or not: a journaled-healthy device may
+    #    have re-wedged since the killed run.
     print("\n=== probe: bounded device probe")
     ok, info = probe(args.probe_timeout)
     statuses["probe"] = "OK" if ok else info
+    journal.append("step", key="probe", status=statuses["probe"])
     if not ok:
         print(f"\nDevice unreachable ({info}) — nothing captured.")
         return 3
@@ -101,7 +169,7 @@ def main() -> int:
     computes = "fp32" if args.quick else "fp32,bf16"
     for i in range(args.sessions):
         tag = "harness" if args.sessions == 1 else f"harness[{i + 1}/{args.sessions}]"
-        run(
+        run_j(
             tag,
             [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.harness",
              # Full capture also measures the sharded-family configs at
@@ -137,7 +205,7 @@ def main() -> int:
     #    captures a wedged pass internally (BENCH_MAX_RETRIES, default 1),
     #    so the outer bound must cover two probe+measure passes + backoff —
     #    a shorter cap would kill the retry that exists to save the row.
-    bench = run("bench", [py, "bench.py"], 2600, statuses)
+    bench = run_j("bench", [py, "bench.py"], 2600, statuses, commit=False)
     if bench:
         line = next(
             (l for l in reversed(bench.stdout.splitlines()) if l.startswith("{")), None
@@ -170,16 +238,20 @@ def main() -> int:
                 # Atomic: a crash mid-write must not leave a torn
                 # bench_latest.json as the round's committed headline.
                 atomic_write_text(ROOT / "perf" / "bench_latest.json", line + "\n")
+    if not step_done(completed, "bench"):
+        # Journaled AFTER the JSON verdict above: the wedged-row refusal is
+        # the step's real status, so a resume re-runs refused benches.
+        journal.append("step", key="bench", status=str(statuses.get("bench", "?")))
 
     # 4. Perf sweep ranking.
     if not args.skip_perf_sweep:
         sweep_cmd = [py, "scripts/perf_sweep.py", "--repeats", "50"]
         if args.quick:
             sweep_cmd.append("--quick")
-        run("perf_sweep", sweep_cmd, 7200, statuses)
+        run_j("perf_sweep", sweep_cmd, 7200, statuses)
 
     # 5. Warehouse: this run's corpus + the reference's own.
-    run(
+    run_j(
         "ingest_ours",
         [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "ingest",
          "--logs", "logs", "--repo-root", "."],
@@ -192,14 +264,14 @@ def main() -> int:
         src = REFERENCE / "all_runs.csv"
         if src.exists() and not (imp / "all_runs.csv").exists():
             shutil.copy(src, imp / "all_runs.csv")
-        run(
+        run_j(
             "ingest_reference",
             [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "ingest",
              "--logs", str(REFERENCE / "final_project" / "logs"), "--repo-root", ""],
             600,
             statuses,
         )
-        run(
+        run_j(
             "ingest_reference_import",
             [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "ingest",
              "--logs", str(imp), "--repo-root", ""],
@@ -208,14 +280,14 @@ def main() -> int:
         )
 
     # 6. Report + narrative + exports.
-    run(
+    run_j(
         "report",
         [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "report",
          "--out", "analysis_exports/best_runs_report.md"],
         300,
         statuses,
     )
-    run(
+    run_j(
         "narrative",
         [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "narrative",
          "--out", "docs/ANALYSIS.md"],
@@ -223,7 +295,7 @@ def main() -> int:
         statuses,
     )
     for view in ("best_runs", "run_stats", "perf_runs"):
-        run(
+        run_j(
             f"export_{view}",
             [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "export",
              "--view", view, "--out", f"analysis_exports/{view}.csv"],
@@ -232,7 +304,7 @@ def main() -> int:
         )
 
     # 7. Combined plots (reference + TPU on the same axes).
-    run(
+    run_j(
         "plots",
         [py, "-m", "cuda_mpi_gpu_cluster_programming_tpu.analysis", "plot",
          "--out", "plots"],

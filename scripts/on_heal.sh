@@ -36,6 +36,30 @@ if ! timeout 120 python scripts/lint.py 2>&1 | tee -a "$LOG"; then
     say "STATICCHECK FINDINGS — fix or # noqa before committing this round's evidence"
 fi
 
+say "supervisor drill (seeded stage_sdc + device_loss chaos on the CPU mesh)"
+# Recovery paths are PROVEN before any heal-window chip time is spent: the
+# elastic supervisor must trip on an injected in-graph digest corruption
+# (sp forward) and an injected device loss (tp forward), degrade down its
+# ladder, replay the batch, and still print the golden 29.2931 head
+# (docs/RESILIENCE.md "Elastic degradation ladder"). A broken recovery
+# path found DURING an incident costs the window; found here it costs 90 s.
+SUPERVISE_DRILL_OK=1
+for drill in "v2.2_sharded stage_sdc=1" "v7_tp device_loss=1"; do
+    set -- $drill; cfg=$1; fault=$2
+    if ! timeout 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        CHAOS_SPEC="seed=3,$fault" \
+        python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+        --config "$cfg" --shards 4 --supervise --height 63 --width 63 \
+        --repeats 2 --warmup 1 2>&1 \
+        | grep -E "DEGRADED|Supervisor:|first 10 values" | tee -a "$LOG" \
+        | grep -q "Supervisor: attempts="; then
+        say "SUPERVISOR DRILL FAILED ($cfg $fault) — recovery path broken; fix before relying on elastic serving this window"
+        SUPERVISE_DRILL_OK=0
+    fi
+done
+[ "$SUPERVISE_DRILL_OK" = 1 ] && say "supervisor drills OK (trip -> degrade -> replay proven on CPU)"
+
 # 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
 # timing once turned a ~30 s case into a 600 s timeout. If a test suite is
 # mid-flight when the window opens, wait it out (bounded) instead of

@@ -4,9 +4,12 @@ The capability the reference lacks (SURVEY §5.4): weights shared across
 backends from one file rather than re-synthesized per version.
 """
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import forward_blocks12
 from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
@@ -160,6 +163,126 @@ def test_missing_checkpoint_still_file_not_found(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         ckpt.load_params_npz(tmp_path / "absent.npz")
+
+
+def test_sharded_tree_roundtrip_and_gc(tmp_path):
+    """Sharded-tree save/load: bit-exact roundtrip, leaves dealt across the
+    requested shard files, stale generations GC'd after the commit."""
+    params = init_params_random(jax.random.PRNGKey(3))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=3, meta={"step": 1})
+    names = sorted(p.name for p in d.iterdir())
+    assert names == [
+        "MANIFEST.json",
+        "shard_000.gen00000000.npz",
+        "shard_001.gen00000000.npz",
+        "shard_002.gen00000000.npz",
+    ]
+    tree, meta = ckpt.load_tree_sharded(d)
+    assert meta == {"step": 1}
+    assert jax.tree_util.tree_structure(tree) == jax.tree_util.tree_structure(params)
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(tree)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # Second generation replaces the first (post-commit GC).
+    ckpt.save_tree_sharded(d, params, n_shards=3, meta={"step": 2})
+    names = sorted(p.name for p in d.iterdir())
+    assert names == [
+        "MANIFEST.json",
+        "shard_000.gen00000001.npz",
+        "shard_001.gen00000001.npz",
+        "shard_002.gen00000001.npz",
+    ]
+    assert ckpt.load_tree_sharded(d)[1] == {"step": 2}
+
+
+def test_sharded_save_kill_mid_shard_write_keeps_last_good(tmp_path, monkeypatch):
+    """A kill while writing shard k>0 of the new generation: the manifest
+    still names the previous complete generation, which loads."""
+    params = init_params_random(jax.random.PRNGKey(4))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=3, meta={"step": 1})
+    calls = []
+    orig = ckpt.np.savez
+
+    def exploding_savez(fh, **kw):
+        calls.append(1)
+        if len(calls) >= 2:
+            raise RuntimeError("simulated kill mid sharded save")
+        return orig(fh, **kw)
+
+    monkeypatch.setattr(ckpt.np, "savez", exploding_savez)
+    with pytest.raises(RuntimeError):
+        ckpt.save_tree_sharded(d, params, n_shards=3, meta={"step": 2})
+    monkeypatch.undo()
+    tree, meta = ckpt.load_tree_sharded(d)
+    assert meta == {"step": 1}  # last-good generation
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(tree)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_save_kill_before_manifest_commit_keeps_last_good(
+    tmp_path, monkeypatch
+):
+    """All new shard files written but the kill lands before the manifest
+    replace (the commit point): the old manifest + old generation win, and
+    the orphaned new-generation files are invisible."""
+    params = init_params_random(jax.random.PRNGKey(5))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=2, meta={"step": 1})
+    monkeypatch.setattr(
+        ckpt,
+        "atomic_write_text",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("kill pre-commit")),
+    )
+    with pytest.raises(RuntimeError):
+        ckpt.save_tree_sharded(d, params, n_shards=2, meta={"step": 2})
+    monkeypatch.undo()
+    _tree, meta = ckpt.load_tree_sharded(d)
+    assert meta == {"step": 1}
+    # Orphaned gen-1 files exist on disk but the manifest never names them.
+    manifest = json.loads((d / ckpt.MANIFEST_NAME).read_text())
+    assert all(f.endswith(".gen00000000.npz") for f in manifest["files"])
+
+
+def test_sharded_manifest_and_shard_corruption_raise_value_error(tmp_path):
+    import pytest
+
+    params = init_params_random(jax.random.PRNGKey(6))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=2)
+    # Torn manifest (pre-atomic-writer crash / failing medium).
+    good_manifest = (d / ckpt.MANIFEST_NAME).read_text()
+    (d / ckpt.MANIFEST_NAME).write_text(good_manifest[: len(good_manifest) // 2])
+    with pytest.raises(ValueError, match="manifest"):
+        ckpt.load_tree_sharded(d)
+    (d / ckpt.MANIFEST_NAME).write_text(good_manifest)
+    # Truncated shard file.
+    shard = d / json.loads(good_manifest)["files"][0]
+    shard.write_bytes(shard.read_bytes()[:16])
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        ckpt.load_tree_sharded(d)
+    # Missing directory entirely.
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_tree_sharded(tmp_path / "absent")
+
+
+def test_sharded_train_state_roundtrip_and_like_structures(tmp_path):
+    """(params, opt_state, step) through the sharded format into the exact
+    optimizer-state structure — the train CLI's --checkpoint-shards path."""
+    import optax
+
+    params = init_params_random(jax.random.PRNGKey(7))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    d = tmp_path / "state"
+    ckpt.save_train_state_sharded(d, params, opt_state, step=9, n_shards=4)
+    p2, o2, step = ckpt.load_train_state_sharded(d, params, opt.init(params))
+    assert step == 9
+    assert jax.tree_util.tree_structure(o2) == jax.tree_util.tree_structure(opt_state)
+    for a, b in zip(jax.tree_util.tree_leaves(opt_state), jax.tree_util.tree_leaves(o2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(p2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_train_state_roundtrip_sgd_and_adam(tmp_path):

@@ -247,6 +247,55 @@ def test_host_sync_scoping(tmp_path):
     assert len(findings_for(item, "host-sync-in-hot-loop")) == 1
 
 
+def test_host_sync_off_timed_path_exemption(tmp_path):
+    """The in-graph sentinel contract: digest screening inside a function
+    decorated @off_timed_path is exempt (it is a host round trip BY DESIGN,
+    between timed regions); the same sync undecorated still trips. Both in
+    supervisor.py, which the rule now scopes alongside run.py."""
+    f = tmp_path / "supervisor.py"
+    f.write_text(
+        "import numpy as np\n"
+        "from cuda_mpi_gpu_cluster_programming_tpu.resilience.sentinel import (\n"
+        "    off_timed_path,\n"
+        ")\n"
+        "@off_timed_path\n"
+        "def screen(digests):\n"
+        "    out = {}\n"
+        "    for stage, vec in digests.items():\n"
+        "        out[stage] = np.asarray(vec)\n"
+        "    return out\n"
+        "def hot(digests):\n"
+        "    out = {}\n"
+        "    for stage, vec in digests.items():\n"
+        "        out[stage] = np.asarray(vec)\n"
+        "    return out\n"
+    )
+    found = findings_for(f, "host-sync-in-hot-loop")
+    assert len(found) == 1
+    assert found[0].line == 14  # the undecorated copy only
+    assert "off_timed_path" in found[0].message
+
+
+def test_host_sync_scope_includes_run_and_supervisor():
+    """run.py and resilience/supervisor.py are measurement surfaces now —
+    and the shipped code stays clean under the grown scope (the repo-clean
+    assertion for the in-graph taps)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        HostSyncInHotLoopRule,
+        _HOT_LOOP_FILES,
+    )
+
+    assert {"run.py", "supervisor.py"} <= _HOT_LOOP_FILES
+    rule = HostSyncInHotLoopRule()
+    assert rule.applies(Path("cuda_mpi_gpu_cluster_programming_tpu/run.py"))
+    for rel in (
+        "cuda_mpi_gpu_cluster_programming_tpu/run.py",
+        "cuda_mpi_gpu_cluster_programming_tpu/resilience/supervisor.py",
+        "cuda_mpi_gpu_cluster_programming_tpu/resilience/sentinel.py",
+    ):
+        assert findings_for(ROOT / rel, "host-sync-in-hot-loop") == []
+
+
 def test_key_reuse_split_and_branches_ok(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(
