@@ -51,6 +51,14 @@ import time
 
 BASELINE_IMG_PER_SEC = 1.0 / 0.183  # reference V4 best, RTX 3090 (BASELINE.md)
 METRIC = "alexnet_blocks12_images_per_sec"
+SERVE_METRIC = "alexnet_blocks12_serve_images_per_sec"
+
+# "measure" = the historical one-shot throughput contract below;
+# "serve" = the continuous-batching service bench (docs/SERVING.md): a
+# journaled Poisson load run through serving.InferenceServer reporting
+# p50/p99 request latency + sustained img/s, plus a seeded device_loss
+# chaos drill proving in-flight requests finish via supervisor replay.
+MODE = os.environ.get("BENCH_MODE", "measure")
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
 # Opt-in sweep: one JSON row per listed config (the V1->V5 story); unset =
@@ -336,6 +344,193 @@ def _child() -> int:
     return 0
 
 
+def _serve_drill(model_cfg) -> dict:
+    """Seeded ``device_loss`` chaos drill under load (docs/SERVING.md):
+    every in-flight request must finish via supervisor replay, and the
+    outputs must be bit-identical to an unfaulted server pinned to the
+    rung the faulted one degraded to (the PR 5 replay contract, now
+    asserted through the serving stack)."""
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.queue import OK
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.server import (
+        InferenceServer,
+        ServeConfig,
+    )
+
+    n_req = int(os.environ.get("BENCH_SERVE_DRILL_REQS", "6"))
+    scfg = ServeConfig(
+        config=os.environ.get("BENCH_SERVE_DRILL_CONFIG", "v2.2_sharded"),
+        n_shards=int(os.environ.get("BENCH_SERVE_DRILL_SHARDS", "2")),
+        max_batch=4,
+        supervise=True,
+        model_cfg=model_cfg,
+    )
+    # Distinct per-request inputs so the bit-identical compare would catch
+    # cross-request slicing bugs, not just forward-path corruption.
+    m = model_cfg
+    imgs = [
+        np.full((1, m.in_height, m.in_width, m.in_channels), 1.0 + 0.01 * i, np.float32)
+        for i in range(n_req)
+    ]
+
+    def _drain(server):
+        handles = [server.submit(im) for im in imgs]
+        server.run_until_drained()  # deterministic: all pending up front
+        return handles
+
+    saved = os.environ.get(chaos.CHAOS_ENV)
+    os.environ[chaos.CHAOS_ENV] = os.environ.get(
+        "BENCH_SERVE_DRILL_CHAOS", "seed=3,device_loss=1"
+    )
+    chaos.reset()
+    try:
+        faulted = InferenceServer(scfg)
+        handles = _drain(faulted)
+    finally:
+        if saved is None:
+            os.environ.pop(chaos.CHAOS_ENV, None)
+        else:
+            os.environ[chaos.CHAOS_ENV] = saved
+        chaos.reset()
+    sup = faulted.sup
+    # Clean run pinned to the rung the faulted service landed on: replayed
+    # outputs must carry no trace of the trip.
+    clean = InferenceServer(scfg, ladder=[sup.entry])
+    clean_handles = _drain(clean)
+    bit_identical = all(
+        a.status == OK and b.status == OK and np.array_equal(a.result, b.result)
+        for a, b in zip(handles, clean_handles)
+    )
+    return {
+        "config": scfg.config,
+        "shards": scfg.n_shards,
+        "n_requests": n_req,
+        "completed": sum(1 for h in handles if h.status == OK),
+        "trips": [t.kind for t in sup.trips],
+        "degradations": len(sup.events),
+        "final_entry": sup.entry.key,
+        "replayed_in_flight": bool(sup.trips),
+        "bit_identical": bit_identical,
+    }
+
+
+def _serve_main() -> int:
+    """BENCH_MODE=serve: one JSON row for a journaled Poisson serve run.
+
+    Tunables (env): BENCH_SERVE_CONFIG (BENCH_CONFIG), BENCH_SERVE_SHARDS
+    (1), BENCH_SERVE_RATE (50 req/s), BENCH_SERVE_DURATION (3 s),
+    BENCH_SERVE_MAX_BATCH (8), BENCH_SERVE_DEADLINE_S (30),
+    BENCH_SERVE_SUPERVISE (1), BENCH_SERVE_JOURNAL (tempdir),
+    BENCH_SERVE_HEIGHT/WIDTH (227 — CI smokes shrink the geometry),
+    BENCH_SERVE_DRILL (1), BENCH_SERVE_DRILL_CONFIG (v2.2_sharded),
+    BENCH_SERVE_DRILL_SHARDS (2). Always exactly one JSON line, exit 0.
+    """
+    import tempfile
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
+
+    def fail(msg: str, platform: str = "unknown") -> int:
+        row = _error_obj(msg, platform)
+        row["metric"] = SERVE_METRIC
+        print(json.dumps(row))
+        return 0
+
+    ok, info = probe(PROBE_TIMEOUT)
+    if not ok:
+        return fail(f"device {info}")
+    platform = info
+    try:
+        import dataclasses
+
+        from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.loadgen import (
+            percentile,
+            run_load,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.server import (
+            InferenceServer,
+            ServeConfig,
+            request_latencies_from_journal,
+        )
+
+        model_cfg = dataclasses.replace(
+            BLOCKS12,
+            in_height=int(os.environ.get("BENCH_SERVE_HEIGHT", "227")),
+            in_width=int(os.environ.get("BENCH_SERVE_WIDTH", "227")),
+        )
+        journal_path = os.environ.get("BENCH_SERVE_JOURNAL") or os.path.join(
+            tempfile.gettempdir(), f"serve_journal_{os.getpid()}.jsonl"
+        )
+        scfg = ServeConfig(
+            config=os.environ.get("BENCH_SERVE_CONFIG", CONFIG),
+            n_shards=int(os.environ.get("BENCH_SERVE_SHARDS", "1")),
+            compute=COMPUTE,
+            max_batch=int(os.environ.get("BENCH_SERVE_MAX_BATCH", "8")),
+            plan_path=PLAN_PATH,
+            supervise=os.environ.get("BENCH_SERVE_SUPERVISE", "1") != "0",
+            journal_path=journal_path,
+            default_deadline_s=float(
+                os.environ.get("BENCH_SERVE_DEADLINE_S", "30")
+            )
+            or None,
+            model_cfg=model_cfg,
+        )
+        server = InferenceServer(scfg)
+        server.start()
+        try:
+            report = run_load(
+                server,
+                rate_rps=float(os.environ.get("BENCH_SERVE_RATE", "50")),
+                duration_s=float(os.environ.get("BENCH_SERVE_DURATION", "3")),
+                seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+            )
+        finally:
+            server.stop()
+        # p50/p99 from the JOURNAL, not the in-memory report: the
+        # crash-consistent trail is the number of record (the report's
+        # handle-side percentiles cross-check it in tests).
+        jlat = request_latencies_from_journal(journal_path)
+        row = {
+            "metric": SERVE_METRIC,
+            "value": round(report.sustained_img_s, 1),
+            "unit": "img/s",
+            "p50_ms": percentile(jlat, 50),
+            "p99_ms": percentile(jlat, 99),
+            "n_requests": report.n_requests,
+            "n_ok": report.n_ok,
+            "n_shed": report.n_shed,
+            "n_failed": report.n_failed,
+            "n_rejected": report.n_rejected,
+            "cache_misses_post_warmup": server.stats.cache_misses,
+            "warmup_compiles": server.stats.warmup_compiles,
+            "buckets": list(server.buckets),
+            "rate_rps": float(os.environ.get("BENCH_SERVE_RATE", "50")),
+            "duration_s": round(report.duration_s, 3),
+            "config": scfg.config,
+            "shards": scfg.n_shards,
+            "compute": scfg.compute,
+            "supervise": scfg.supervise,
+            "platform": platform,
+            "journal": journal_path,
+        }
+        if server.sup is not None:
+            row["trips"] = [t.kind for t in server.sup.trips]
+            row["entry"] = server.sup.entry.key
+        if os.environ.get("BENCH_SERVE_DRILL", "1") != "0":
+            try:
+                row["drill"] = _serve_drill(model_cfg)
+            except Exception as e:
+                # The drill is evidence, not the headline: its failure is a
+                # visible note on the row, never a lost load measurement.
+                row["drill"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps(row))
+        return 0
+    except Exception as e:
+        return fail(f"{type(e).__name__}: {e}"[:200], platform)
+
+
 def _measure_once(configs=None) -> list:
     """One full probe+measure pass; returns the JSON row list to emit, one
     row per ``configs`` entry (default: the full BENCH_CONFIGS list; the
@@ -454,6 +649,8 @@ def main() -> int:
     measured and journaled rows are replayed instead of re-measured — a
     killed sweep restarts at the first missing config.
     """
+    if MODE == "serve":
+        return _serve_main()
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,

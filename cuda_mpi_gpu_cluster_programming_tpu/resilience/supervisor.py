@@ -119,6 +119,7 @@ class Supervisor:
         sentinel_cfg: SentinelConfig = SentinelConfig(),
         journal: Optional[Journal] = None,
         on_event: Optional[Callable[[DegradedEvent], None]] = None,
+        on_rebuild: Optional[Callable[[LadderEntry], None]] = None,
         site: str = "supervisor",
     ):
         if not ladder:
@@ -128,6 +129,11 @@ class Supervisor:
         self.plan = plan
         self.journal = journal
         self.on_event = on_event
+        # Called after a degrade lands on a freshly BUILT rung, before the
+        # failed batch replays on it — the serving layer re-warms its batch
+        # buckets here so even the replay hits a compiled shape and the
+        # zero-cache-miss dispatch discipline survives degradation.
+        self.on_rebuild = on_rebuild
         self.site = site
         self.checker = StageDigests(sentinel_cfg, site=site)
         self.trips: List[SDC] = []
@@ -202,6 +208,30 @@ class Supervisor:
             self._journal("sup_build", key=self.entry.key, entry=self.entry.key)
         return self._fwd
 
+    @off_timed_path
+    def warm(self, params, x) -> float:
+        """Compile + run the current rung on one input shape, outside the
+        screened/chaos-drawn execute path (warmup must neither consume a
+        drill's fault budget nor count as a screened batch). Returns the
+        wall ms — first call per shape is the compile; the serving layer
+        warms every batch bucket through here so dispatch never compiles.
+        Journaled as ``sup_warm`` so the warmup/steady-state boundary is
+        auditable in the same trail as the trips."""
+        import jax
+
+        t0 = time.perf_counter()
+        out, _ = self.fwd()(params, x)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._journal(
+            "sup_warm",
+            key=f"warm:{self.entry.key}:b{int(x.shape[0])}",
+            entry=self.entry.key,
+            batch=int(x.shape[0]),
+            ms=round(ms, 3),
+        )
+        return ms
+
     # ----------------------------------------------------------- execution
 
     def _maybe_chaos_device_loss(self, entry: LadderEntry) -> None:
@@ -274,6 +304,8 @@ class Supervisor:
             self._fwd = None
             try:
                 self.fwd()  # build eagerly: an unbuildable rung degrades again
+                if self.on_rebuild is not None:
+                    self.on_rebuild(self.entry)
                 return
             except Exception as e:  # noqa — next hop carries the cause
                 last = e

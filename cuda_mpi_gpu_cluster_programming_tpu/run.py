@@ -138,6 +138,35 @@ def make_parser() -> argparse.ArgumentParser:
         help="with --supervise: journal every build/trip/degrade/ok "
         "transition to this jsonl path (resilience.journal format)",
     )
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="run the continuous-batching inference service under a seeded "
+        "Poisson load instead of the one-shot forward: admission queue with "
+        "per-request deadlines, bucketed batch assembly (compile-cache-"
+        "safe padded shapes), journaled dispatch; with --supervise the "
+        "PR 5 elastic ladder degrades in-service instead of failing "
+        "requests (docs/SERVING.md). Blocks 1-2 configs only; prints "
+        "machine-parsed 'Serve load:' and 'Serve:' lines",
+    )
+    p.add_argument("--serve-rate", type=float, default=20.0,
+                   help="with --serve: Poisson arrival rate (requests/s)")
+    p.add_argument("--serve-duration", type=float, default=2.0,
+                   help="with --serve: load-generation window (s)")
+    p.add_argument("--serve-max-batch", type=int, default=8,
+                   help="with --serve: largest dispatch bucket (powers of "
+                   "two below it form the default bucket set)")
+    p.add_argument("--serve-deadline-s", type=float, default=0.0,
+                   help="with --serve: per-request deadline (0 = none); "
+                   "expired requests are shed explicitly, never dropped")
+    p.add_argument("--serve-journal", default="",
+                   help="with --serve: journal every warm/batch/shed/"
+                   "degrade record to this jsonl path (the serve bench's "
+                   "p50/p99 source)")
+    p.add_argument("--serve-buckets", default="",
+                   help="with --serve: comma-separated explicit bucket "
+                   "sizes (overrides the powers-of-two/TunePlan-derived "
+                   "set)")
     return p
 
 
@@ -284,6 +313,59 @@ def main(argv=None) -> int:
         params = init_det(model_cfg)
     else:
         params = init_rnd(kp, model_cfg)
+
+    if args.serve:
+        # Continuous-batching service mode: the serving subsystem owns the
+        # build (per-bucket warmup through the compile cache; with
+        # --supervise the elastic ladder), so every later build/measure
+        # path below is bypassed.
+        if exec_cfg.model != "blocks12":
+            print("--serve supports the Blocks 1-2 configs only", file=sys.stderr)
+            return 2
+        if args.fallback_chain:
+            print(
+                "--serve degrades through the supervisor ladder "
+                "(--supervise); drop --fallback-chain",
+                file=sys.stderr,
+            )
+            return 2
+        from .serving.loadgen import run_load
+        from .serving.server import InferenceServer, ServeConfig
+
+        buckets = tuple(
+            int(b) for b in args.serve_buckets.split(",") if b.strip()
+        )
+        scfg = ServeConfig(
+            config=args.config,
+            n_shards=args.shards,
+            compute=args.compute,
+            max_batch=args.serve_max_batch,
+            buckets=buckets or None,
+            plan_path=args.plan,
+            supervise=args.supervise,
+            journal_path=args.serve_journal,
+            default_deadline_s=args.serve_deadline_s or None,
+            model_cfg=blocks_cfg,
+        )
+        server = InferenceServer(scfg, params=params, plan=plan)
+        server.start()
+        try:
+            report = run_load(
+                server,
+                rate_rps=args.serve_rate,
+                duration_s=args.serve_duration,
+                seed=args.seed,
+            )
+        finally:
+            server.stop()
+        print(f"Serve buckets: {','.join(str(b) for b in server.buckets)}")
+        print(f"Serve load: {report.summary()}")
+        print(f"Serve: {server.summary()}")
+        if server.sup is not None:
+            # Same machine-parsed supervisor line as the one-shot
+            # --supervise path (harness._RE_SUPERVISOR).
+            print(f"Supervisor: {server.sup.summary()}")
+        return 0
 
     if args.input == "native":
         # C++ pipeline generates the batch host-side (the reference's C++

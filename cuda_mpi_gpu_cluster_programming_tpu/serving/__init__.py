@@ -1,0 +1,14 @@
+"""Continuous-batching inference service (docs/SERVING.md).
+
+The forward path grown into a serving loop: an admission queue with
+per-request deadlines (``queue``), bucketed batch assembly over a fixed
+padded-shape set so the persistent compile cache is hit, never missed
+(``batcher``), a dispatch loop wrapping ``configs.build_forward`` — or the
+PR 5 elastic supervisor as the in-service degradation ladder — that
+journals every batch (``server``), and a Poisson load generator with
+latency-percentile reporting (``loadgen``).
+
+Layering rule: ``queue``/``batcher``/``loadgen`` are stdlib+numpy only (no
+jax import — the same rule as ``resilience.policy``); only ``server`` pays
+the backend import, at dispatch-build time.
+"""

@@ -1,0 +1,139 @@
+"""Poisson load generator + latency reporting for the serve bench.
+
+Arrivals are an explicitly seeded Poisson process (``random.Random(seed)``
+exponential inter-arrival gaps — deterministic schedule per seed, the same
+reproducibility rule as PR 1's jittered backoff), submitted against a
+running :class:`~.server.InferenceServer` on the caller's thread while the
+server's dispatch thread drains them continuously.
+
+The report separates the three ways a request can finish — OK, SHED
+(deadline), FAILED — and computes p50/p99 over the OK latencies; sustained
+img/s is completed images over the span from first submit to last
+completion (arrival ramp included: the number a capacity planner can hold
+against the offered rate). ``percentile`` is the nearest-rank estimator so
+small smoke runs report an actually-observed latency, never an
+interpolated one.
+
+Stdlib + numpy only (no jax import).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .queue import FAILED, OK, SHED, RequestHandle
+from .server import InferenceServer
+
+
+def poisson_arrivals(
+    rate_rps: float, duration_s: float, seed: int = 0
+) -> List[float]:
+    """Arrival offsets (seconds from start) of a seeded Poisson process."""
+    if rate_rps <= 0 or duration_s <= 0:
+        return []
+    rng = random.Random(f"loadgen:{seed}")
+    t, out = 0.0, []
+    while True:
+        t += rng.expovariate(rate_rps)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def percentile(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None on empty input."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    if q <= 0:
+        return s[0]
+    rank = int(np.ceil(q / 100.0 * len(s)))
+    return s[min(max(rank, 1), len(s)) - 1]
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """One load run's verdict — everything the bench JSON row needs."""
+
+    n_requests: int
+    n_ok: int
+    n_shed: int
+    n_failed: int
+    n_rejected: int  # admission-control refusals (QueueFull / too wide)
+    p50_ms: Optional[float]
+    p99_ms: Optional[float]
+    sustained_img_s: float
+    duration_s: float
+    latencies_ms: List[float]
+
+    def summary(self) -> str:
+        """Machine-parseable 'Serve load:' payload for the run CLI."""
+        p50 = f"{self.p50_ms:.3f}" if self.p50_ms is not None else "nan"
+        p99 = f"{self.p99_ms:.3f}" if self.p99_ms is not None else "nan"
+        return (
+            f"reqs={self.n_requests} ok={self.n_ok} shed={self.n_shed} "
+            f"failed={self.n_failed} rejected={self.n_rejected} "
+            f"p50_ms={p50} p99_ms={p99} "
+            f"img_s={self.sustained_img_s:.1f} wall_s={self.duration_s:.2f}"
+        )
+
+
+def run_load(
+    server: InferenceServer,
+    *,
+    rate_rps: float,
+    duration_s: float,
+    seed: int = 0,
+    make_input: Optional[Callable[[int], np.ndarray]] = None,
+    deadline_s: Optional[float] = None,
+    wait_timeout_s: float = 120.0,
+) -> LoadReport:
+    """Drive a started server with Poisson traffic and wait everything out.
+
+    ``make_input(i)`` supplies the i-th request's (n, H, W, C) array;
+    default is a single deterministic image matching the server's model
+    geometry. Every submitted handle is awaited (bounded), so the report
+    accounts for each request exactly once: ok + shed + failed +
+    rejected == offered.
+    """
+    if make_input is None:
+        m = server._model_cfg()
+        img = np.ones((1, m.in_height, m.in_width, m.in_channels), np.float32)
+        make_input = lambda i: img  # noqa: E731 — trivial default factory
+    arrivals = poisson_arrivals(rate_rps, duration_s, seed)
+    handles: List[RequestHandle] = []
+    n_rejected = 0
+    t0 = time.monotonic()
+    for i, at in enumerate(arrivals):
+        now = time.monotonic() - t0
+        if at > now:
+            time.sleep(at - now)
+        try:
+            handles.append(server.submit(make_input(i), deadline_s=deadline_s))
+        except (ValueError, RuntimeError):
+            n_rejected += 1  # QueueFull/too-wide: admission control, counted
+    wait_deadline = time.monotonic() + wait_timeout_s
+    for h in handles:
+        h.wait(max(0.0, wait_deadline - time.monotonic()))
+    ok = [h for h in handles if h.status == OK]
+    lat = [h.latency_ms for h in ok if h.latency_ms is not None]
+    completed_at = [h.completed_at for h in handles if h.completed_at is not None]
+    wall = (max(completed_at) - t0) if completed_at else (time.monotonic() - t0)
+    images_ok = sum(h.n_images for h in ok)
+    return LoadReport(
+        n_requests=len(handles) + n_rejected,
+        n_ok=len(ok),
+        n_shed=sum(1 for h in handles if h.status == SHED),
+        n_failed=sum(1 for h in handles if h.status == FAILED),
+        n_rejected=n_rejected,
+        p50_ms=percentile(lat, 50),
+        p99_ms=percentile(lat, 99),
+        sustained_img_s=images_ok / wall if wall > 0 else 0.0,
+        duration_s=wall,
+        latencies_ms=lat,
+    )

@@ -1,0 +1,167 @@
+"""Admission queue: per-request deadlines, FIFO pop, explicit shedding.
+
+Requests enter through :meth:`AdmissionQueue.submit`, which returns a
+:class:`RequestHandle` the caller waits on. The dispatch loop pops FIFO
+prefixes with :meth:`AdmissionQueue.pop_ready`, which *returns* the
+deadline-expired requests it sheds alongside the ones it takes — a shed
+request always completes its handle with status ``SHED`` and is handed back
+for journaling, never silently dropped (the same no-silent-loss contract as
+PR 1's ``DegradedEvent``).
+
+Stdlib + numpy only (no jax import) so tests and the load generator pay
+nothing to exercise queue semantics; ``Deadline`` is PR 1's monotonic
+budget vocabulary, reused unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.policy import Deadline
+
+# Terminal request statuses. PENDING is the only non-terminal state; a
+# handle's status moves exactly once, under the completing thread.
+PENDING = "PENDING"
+OK = "OK"
+SHED = "SHED"  # deadline expired before dispatch — explicit, journaled
+FAILED = "FAILED"  # dispatch raised even after the supervisor's ladder
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: backpressure, not silent buffering to OOM."""
+
+
+class RequestHandle:
+    """Caller-facing completion handle for one submitted request."""
+
+    def __init__(self, rid: str, n_images: int):
+        self.rid = rid
+        self.n_images = n_images
+        self.status = PENDING
+        self.result: Optional[np.ndarray] = None
+        self.error = ""
+        self.submitted_at = time.monotonic()
+        self.completed_at: Optional[float] = None
+        self._done = threading.Event()
+
+    def _complete(self, status: str, result=None, error: str = "") -> None:
+        self.status = status
+        self.result = result
+        self.error = error
+        self.completed_at = time.monotonic()
+        self._done.set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return self._done.wait(timeout_s)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        """submit -> complete wall latency (the user-visible number the
+        serve bench reports percentiles of); None while pending."""
+        if self.completed_at is None:
+            return None
+        return (self.completed_at - self.submitted_at) * 1e3
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued unit of work: ``x`` is a host-side (n, H, W, C) array."""
+
+    rid: str
+    x: np.ndarray
+    deadline: Deadline
+    handle: RequestHandle
+
+    @property
+    def n_images(self) -> int:
+        return int(self.x.shape[0])
+
+
+class AdmissionQueue:
+    """Thread-safe FIFO with bounded depth and deadline-aware popping."""
+
+    def __init__(self, max_pending: int = 1024):
+        self.max_pending = max_pending
+        self._pending: Deque[Request] = deque()
+        self._cv = threading.Condition()
+        self._seq = 0
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def submit(
+        self,
+        x,
+        *,
+        deadline_s: Optional[float] = None,
+        rid: Optional[str] = None,
+    ) -> RequestHandle:
+        """Admit one request. ``x`` is (H, W, C) or (n, H, W, C); a single
+        image is promoted to a 1-batch. Raises :class:`QueueFull` past
+        ``max_pending`` — admission control is the caller-visible
+        backpressure signal, not an unbounded buffer."""
+        x = np.asarray(x)
+        if x.ndim == 3:
+            x = x[None]
+        if x.ndim != 4:
+            raise ValueError(f"request input must be (H,W,C) or (n,H,W,C), got {x.shape}")
+        with self._cv:
+            if len(self._pending) >= self.max_pending:
+                raise QueueFull(
+                    f"admission queue at max_pending={self.max_pending}"
+                )
+            self._seq += 1
+            rid = rid or f"r{self._seq:06d}"
+            handle = RequestHandle(rid, int(x.shape[0]))
+            self._pending.append(
+                Request(rid, x, Deadline.after(deadline_s), handle)
+            )
+            self._cv.notify_all()
+            return handle
+
+    def wait_nonempty(self, timeout_s: float) -> bool:
+        """Block until a request is pending (or timeout) — the dispatch
+        loop's idle parking spot, so an empty service burns no CPU."""
+        with self._cv:
+            return self._cv.wait_for(lambda: bool(self._pending), timeout_s)
+
+    def pop_ready(self, max_images: int) -> Tuple[List[Request], List[Request]]:
+        """Pop a FIFO prefix of live requests totaling <= ``max_images``
+        images, shedding every expired request encountered on the way.
+
+        Returns ``(taken, shed)``. Shed handles are completed with status
+        ``SHED`` *here* (the caller stops waiting immediately) and the
+        requests are returned so the server journals each one — counted,
+        attributed, never silently dropped. FIFO order is preserved: the
+        first live request that does not fit closes the batch (no
+        out-of-order cherry-picking, so no starvation)."""
+        taken: List[Request] = []
+        shed: List[Request] = []
+        images = 0
+        with self._cv:
+            while self._pending:
+                req = self._pending[0]
+                if req.deadline.expired:
+                    self._pending.popleft()
+                    req.handle._complete(
+                        SHED, error="deadline expired before dispatch"
+                    )
+                    shed.append(req)
+                    continue
+                if images + req.n_images > max_images:
+                    break
+                self._pending.popleft()
+                taken.append(req)
+                images += req.n_images
+        return taken, shed

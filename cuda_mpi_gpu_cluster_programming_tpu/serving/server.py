@@ -1,0 +1,399 @@
+"""The continuous-batching inference server: queue -> bucket -> dispatch
+-> (degrade) lifecycle around ``configs.build_forward``.
+
+Dispatch discipline (docs/SERVING.md):
+
+- **Warmup compiles everything, dispatch compiles nothing.** At
+  :meth:`InferenceServer.start` every bucket shape is compiled once (the
+  PR 2 persistent compile cache makes that cheap across restarts); a
+  dispatched batch whose bucket shape is not warmed on the current rung is
+  counted as a ``cache_miss`` — the acceptance number that must be zero
+  after warmup.
+- **Every batch is journaled** (``serve_batch`` records with per-request
+  latencies; ``serve_shed``/``serve_fail`` for the explicit loss paths) via
+  PR 3's fsync'd ``Journal``, so the bench's p50/p99 come from the same
+  crash-consistent trail every other artifact uses.
+- **Degradation, not 500s.** With ``supervise=True`` the forward is the
+  PR 5 elastic :class:`~..resilience.supervisor.Supervisor`: an SDC trip or
+  device loss mid-batch re-plans down the ladder, re-warms every bucket on
+  the new rung (``on_rebuild``), and REPLAYS the in-flight batch — callers
+  get answers, late, instead of errors.
+- **Deadline-aware shedding.** Expired requests complete with status
+  ``SHED`` at assembly time and are journaled — never silently dropped.
+
+The dispatch loop keeps host syncs out of its body (staticcheck's
+``host-sync-in-hot-loop`` rule now covers this file): the timed region
+lives in ``_dispatch``, and result slicing/journal writes run in
+``@off_timed_path`` completion helpers, the same contract the supervisor's
+screening uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.journal import Journal
+from ..resilience.sentinel import off_timed_path
+from .batcher import AssembledBatch, Batcher, power_of_two_buckets
+from .queue import FAILED, OK, AdmissionQueue, Request, RequestHandle
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """How to build and run the service (CLI/bench surface in one place)."""
+
+    config: str = "v1_jit"  # configs.REGISTRY key (blocks12 family)
+    n_shards: int = 1
+    compute: str = "fp32"
+    max_batch: int = 8
+    # None = powers of two up to max_batch, or the TunePlan-derived set
+    # when plan_path names a plan covering this point (tuning.plan_batches).
+    buckets: Optional[Tuple[int, ...]] = None
+    plan_path: str = ""
+    supervise: bool = False
+    journal_path: str = ""
+    max_pending: int = 1024
+    poll_s: float = 0.02
+    default_deadline_s: Optional[float] = None
+    model_cfg: Any = None  # Blocks12Config override (tests use 63x63)
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Steady-state counters the bench row and CLI line surface."""
+
+    n_batches: int = 0
+    n_images: int = 0
+    n_ok: int = 0
+    n_shed: int = 0
+    n_failed: int = 0
+    warmup_compiles: int = 0
+    cache_misses: int = 0  # post-warmup dispatches at an un-warmed shape
+    batch_ms: List[float] = dataclasses.field(default_factory=list)
+
+    def summary(self) -> str:
+        return (
+            f"batches={self.n_batches} images={self.n_images} ok={self.n_ok} "
+            f"shed={self.n_shed} failed={self.n_failed} "
+            f"cache_misses={self.cache_misses} warmups={self.warmup_compiles}"
+        )
+
+
+class InferenceServer:
+    """Continuous-batching service over one execution config.
+
+    Two run modes: :meth:`start`/:meth:`stop` spin the dispatch loop on a
+    background thread (the load-generator path), while
+    :meth:`run_until_drained` runs it inline until the queue empties — the
+    deterministic path the chaos drills and tests use (batch assembly then
+    depends only on submission order, never on thread timing).
+    """
+
+    def __init__(self, cfg: ServeConfig, params=None, plan=None, ladder=None):
+        # ``ladder``: explicit supervisor LadderEntry list (supervise mode
+        # only) — the chaos drills pin a clean comparison server to the
+        # exact rung a faulted run degraded to.
+        self.cfg = cfg
+        self._ladder = ladder
+        self.queue = AdmissionQueue(max_pending=cfg.max_pending)
+        self.stats = ServeStats()
+        self.journal = Journal(cfg.journal_path) if cfg.journal_path else None
+        self._plan = plan
+        self._params = params
+        self._fwd = None
+        self.sup = None  # the Supervisor in supervise mode (drill surface)
+        self._warmed: set = set()  # bucket sizes compiled on the current rung
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._started = False
+        self.buckets = self._resolve_buckets()
+        self._batcher = Batcher(self.queue, self.buckets)
+
+    # ------------------------------------------------------------- building
+
+    def _resolve_buckets(self) -> Tuple[int, ...]:
+        cfg = self.cfg
+        if cfg.buckets:
+            return tuple(sorted(set(int(b) for b in cfg.buckets)))
+        if cfg.plan_path:
+            import jax
+
+            from ..models.alexnet import BLOCKS12
+            from ..tuning.plan import plan_batches
+
+            tuned = plan_batches(
+                cfg.plan_path,
+                device_kind=jax.devices()[0].device_kind,
+                model_cfg=cfg.model_cfg or BLOCKS12,
+                dtype=cfg.compute,
+            )
+            tuned = [b for b in tuned if b <= cfg.max_batch]
+            if tuned:
+                return tuple(tuned)
+        return power_of_two_buckets(cfg.max_batch)
+
+    def _model_cfg(self):
+        from ..models.alexnet import BLOCKS12
+
+        return self.cfg.model_cfg if self.cfg.model_cfg is not None else BLOCKS12
+
+    def _build(self) -> None:
+        from ..configs import REGISTRY, build_forward
+        from ..models.init import init_params_deterministic
+
+        cfg = self.cfg
+        exec_cfg = REGISTRY[cfg.config]
+        if exec_cfg.model != "blocks12":
+            raise ValueError(
+                f"serving supports the Blocks 1-2 configs only, got {cfg.config!r}"
+            )
+        model_cfg = self._model_cfg()
+        if self._params is None:
+            self._params = init_params_deterministic(model_cfg)
+        if cfg.supervise:
+            from ..resilience.supervisor import Supervisor, default_ladder
+
+            self.sup = Supervisor(
+                model_cfg,
+                self._ladder
+                or default_ladder(exec_cfg.strategy, exec_cfg.tier, cfg.n_shards),
+                plan=self._plan,
+                journal=self.journal,
+                on_rebuild=self._rewarm,
+                site="serve",
+            )
+        else:
+            self._fwd = build_forward(
+                exec_cfg,
+                model_cfg,
+                n_shards=cfg.n_shards,
+                compute=cfg.compute,
+                plan=self._plan,
+            )
+
+    def _warm_input(self, bucket: int) -> np.ndarray:
+        m = self._model_cfg()
+        return np.zeros(
+            (bucket, m.in_height, m.in_width, m.in_channels), np.float32
+        )
+
+    @off_timed_path
+    def warmup(self) -> None:
+        """Compile every bucket shape now, before any request is waiting.
+        After this, a dispatch that compiles is a counted cache miss.
+        Off the timed path by contract: warmup fences are setup cost, not
+        serving latency."""
+        import jax
+
+        for bucket in self.buckets:
+            xb = self._warm_input(bucket)
+            if self.sup is not None:
+                ms = self.sup.warm(self._params, xb)
+            else:
+                t0 = time.perf_counter()
+                jax.block_until_ready(self._fwd(self._params, xb))
+                ms = (time.perf_counter() - t0) * 1e3
+            self.stats.warmup_compiles += 1
+            self._warmed.add(bucket)
+            self._journal(
+                "serve_warm", key=f"warm:b{bucket}", bucket=bucket,
+                ms=round(ms, 3),
+            )
+
+    def _rewarm(self, entry) -> None:
+        """Supervisor on_rebuild hook: a degrade landed on a fresh rung, so
+        every bucket must compile again BEFORE the failed batch replays —
+        re-warming here keeps the replay itself a cache hit and the
+        steady-state miss count at zero across degradations."""
+        self._warmed.clear()
+        for bucket in self.buckets:
+            self.sup.warm(self._params, self._warm_input(bucket))
+            self.stats.warmup_compiles += 1
+            self._warmed.add(bucket)
+        self._journal(
+            "serve_rewarm", key=f"rewarm:{entry.key}", entry=entry.key,
+            buckets=list(self.buckets),
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "InferenceServer":
+        """Build, warm every bucket, then serve on a background thread."""
+        if self._started:
+            raise RuntimeError("server already started")
+        self._ensure_built()
+        self._started = True
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-dispatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _ensure_built(self) -> None:
+        if self._fwd is None and self.sup is None:
+            self._build()
+            self.warmup()
+
+    def stop(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        """Stop the dispatch thread; with ``drain`` (default) the loop
+        first finishes everything already admitted."""
+        if self._thread is None:
+            return
+        if drain:
+            deadline = time.monotonic() + timeout_s
+            while len(self.queue) and time.monotonic() < deadline:
+                time.sleep(0.005)
+        self._stop.set()
+        self._thread.join(timeout_s)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._step()
+
+    def run_until_drained(self) -> None:
+        """Inline dispatch until the queue is empty — the deterministic
+        mode: with all requests pre-submitted, batch assembly depends only
+        on FIFO order and the bucket set (chaos drills compare two such
+        runs bit-for-bit)."""
+        self._ensure_built()
+        while len(self.queue):
+            self._step()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _step(self) -> None:
+        batch, shed = self._batcher.next_batch(self.cfg.poll_s)
+        if shed:
+            self._record_shed(shed)
+        if batch is not None:
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: AssembledBatch) -> None:
+        """One timed region: pad -> run -> fence. Completion (slicing,
+        handle wakeups, journal append) happens off the timed path."""
+        import jax
+
+        if batch.bucket not in self._warmed:
+            # Post-warmup compile on the request path — the exact failure
+            # the bucket discipline exists to prevent. Counted AND
+            # journaled, then warmed so it can only fire once per shape.
+            self.stats.cache_misses += 1
+            self._journal(
+                "serve_miss", key=f"miss:b{batch.bucket}", bucket=batch.bucket
+            )
+        xb = batch.padded_input()
+        t0 = time.perf_counter()
+        try:
+            if self.sup is not None:
+                out = self.sup.execute(self._params, xb)
+            else:
+                out = self._fwd(self._params, xb)
+                jax.block_until_ready(out)
+        except Exception as e:  # noqa — terminal failure: ladder exhausted
+            # (supervise) or the bare forward raised. Every in-flight
+            # request completes FAILED with the cause — no hung handles.
+            self._record_failed(batch, e)
+            return
+        batch_ms = (time.perf_counter() - t0) * 1e3
+        self._warmed.add(batch.bucket)
+        self._complete(batch, out, batch_ms)
+
+    @off_timed_path
+    def _complete(self, batch: AssembledBatch, out, batch_ms: float) -> None:
+        """Slice the padded output back per request and wake the handles —
+        one host transfer per batch, contractually between timed regions."""
+        arr = np.asarray(out)
+        lat_ms: Dict[str, float] = {}
+        for req, off in batch.offsets():
+            req.handle._complete(OK, arr[off : off + req.n_images])
+            lat_ms[req.rid] = round(req.handle.latency_ms, 3)
+        self.stats.n_batches += 1
+        self.stats.n_images += batch.n_images
+        self.stats.n_ok += len(batch.requests)
+        self.stats.batch_ms.append(batch_ms)
+        self._journal(
+            "serve_batch",
+            key=f"batch:{batch.seq}",
+            bucket=batch.bucket,
+            n_requests=len(batch.requests),
+            n_images=batch.n_images,
+            pad=batch.pad,
+            batch_ms=round(batch_ms, 3),
+            req_lat_ms=lat_ms,
+            entry=self.sup.entry.key if self.sup is not None else self.cfg.config,
+        )
+
+    @off_timed_path
+    def _record_shed(self, shed: List[Request]) -> None:
+        self.stats.n_shed += len(shed)
+        for req in shed:
+            self._journal(
+                "serve_shed", key=f"shed:{req.rid}", rid=req.rid,
+                n_images=req.n_images,
+            )
+
+    @off_timed_path
+    def _record_failed(self, batch: AssembledBatch, e: BaseException) -> None:
+        cause = f"{type(e).__name__}: {e}"[:200]
+        for req in batch.requests:
+            req.handle._complete(FAILED, error=cause)
+        self.stats.n_failed += len(batch.requests)
+        self._journal(
+            "serve_fail",
+            key=f"fail:{batch.seq}",
+            bucket=batch.bucket,
+            n_requests=len(batch.requests),
+            cause=cause,
+        )
+
+    # ------------------------------------------------------------- frontend
+
+    def submit(
+        self, x, *, deadline_s: Optional[float] = None, rid: Optional[str] = None
+    ) -> RequestHandle:
+        """Admit one request (thread-safe). Requests wider than the largest
+        bucket are rejected at the door — they could never dispatch."""
+        x = np.asarray(x)
+        n = 1 if x.ndim == 3 else int(x.shape[0])
+        if n > self.buckets[-1]:
+            raise ValueError(
+                f"request of {n} images exceeds the largest bucket "
+                f"{self.buckets[-1]} — split it client-side"
+            )
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        return self.queue.submit(x, deadline_s=deadline_s, rid=rid)
+
+    def _journal(self, kind: str, key: str, **payload) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, key=key, **payload)
+
+    def summary(self) -> str:
+        """One machine-parseable line ('Serve: ...' — run CLI contract)."""
+        s = self.stats.summary()
+        buckets = ",".join(str(b) for b in self.buckets)
+        tail = f" entry={self.sup.entry.key} trips={len(self.sup.trips)}" if self.sup else ""
+        return f"{s} buckets={buckets}{tail}"
+
+
+def request_latencies_from_journal(path) -> List[float]:
+    """All per-request latencies (ms) journaled by ``serve_batch`` records —
+    the crash-consistent source the serve bench computes p50/p99 from (a
+    killed run's percentiles cover exactly the requests that completed)."""
+    lats: List[float] = []
+    for rec in Journal.load(path):
+        if rec.get("kind") == "serve_batch":
+            req_lat = rec.get("req_lat_ms")
+            if isinstance(req_lat, dict):
+                lats.extend(
+                    float(v) for v in req_lat.values()
+                    if isinstance(v, (int, float))
+                )
+    return lats

@@ -249,7 +249,15 @@ class UnreducedContractionRule(Rule):
 # host-sync-in-hot-loop
 
 
-_HOT_LOOP_FILES = {"bench.py", "harness.py", "training.py", "run.py", "supervisor.py"}
+# The measurement surfaces plus the serving subsystem's dispatch/load
+# loops: a host sync per dispatched batch is a latency tax on every
+# request, so serving/{server,loadgen,batcher,queue}.py live under the
+# same rule (journal writes and result slicing are exempted via the same
+# @off_timed_path contract the supervisor's screening uses).
+_HOT_LOOP_FILES = {
+    "bench.py", "harness.py", "training.py", "run.py", "supervisor.py",
+    "server.py", "loadgen.py", "batcher.py", "queue.py",
+}
 _TIME_CALLS = {"monotonic", "perf_counter", "time", "process_time"}
 _OFF_TIMED_PATH_DECORATOR = "off_timed_path"
 

@@ -227,6 +227,42 @@ def load_plan(
     return None
 
 
+def plan_batches(
+    path,
+    *,
+    device_kind: str,
+    model_cfg,
+    dtype: str,
+    rev: Optional[str] = None,
+) -> list:
+    """Batch sizes the plan file holds CURRENT tuned winners for at this
+    (device kind, geometry, dtype, code-rev) point, sorted ascending.
+
+    This is the serving bucket-set derivation (docs/SERVING.md): the
+    continuous-batching dispatcher pads every batch to one of these sizes,
+    so every shape it hands the persistent compile cache is a shape the
+    autotuner already swept — tuned winners apply and the cache hits.
+    Stale-rev entries are excluded for the same reason ``load_plan``
+    misses on them: their winners no longer describe the current kernels.
+    Empty when the file is missing/unmatched — callers fall back to the
+    powers-of-two default set."""
+    plans = _read_plans(path)
+    if not plans:
+        return []
+    rev = rev or code_rev()
+    prefix = f"{device_kind}|{shape_key(model_cfg)}|b"
+    suffix = f"|{dtype}|rev={rev}"
+    batches = set()
+    for key, obj in plans.items():
+        if not (key.startswith(prefix) and key.endswith(suffix)):
+            continue
+        try:
+            batches.add(int(obj["batch"]))
+        except (KeyError, TypeError, ValueError):
+            continue  # malformed entry: not a usable bucket, skip it
+    return sorted(batches)
+
+
 def effective_layer_variants(
     plan: TunePlan, base: Optional[KernelVariants] = None
 ) -> LayerVariants:

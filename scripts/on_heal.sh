@@ -60,6 +60,34 @@ for drill in "v2.2_sharded stage_sdc=1" "v7_tp device_loss=1"; do
 done
 [ "$SUPERVISE_DRILL_OK" = 1 ] && say "supervisor drills OK (trip -> degrade -> replay proven on CPU)"
 
+say "serve smoke (continuous-batching Poisson drill on the CPU mesh — docs/SERVING.md)"
+# The serving path is PROVEN before any heal-window chip time, same policy
+# as the supervisor drill above: a short journaled Poisson run through the
+# admission queue -> bucket assembly -> dispatch loop, with the in-load
+# device_loss chaos drill. The verdict gates on: fresh value > 0, zero
+# post-warmup compile-cache misses (the bucket discipline), and the drill
+# finishing ALL in-flight requests via supervisor replay. Journal lands in
+# logs/ so the run's p50/p99 are auditable next to the other artifacts.
+if timeout 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_MODE=serve BENCH_SERVE_HEIGHT=63 BENCH_SERVE_WIDTH=63 \
+    BENCH_SERVE_DURATION=2 BENCH_SERVE_RATE=40 BENCH_SERVE_MAX_BATCH=4 \
+    BENCH_SERVE_JOURNAL=logs/serve_smoke_${FTS}.jsonl \
+    python bench.py 2>>"$LOG" | tail -1 | tee -a "$LOG" \
+    | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+drill = d.get('drill', {})
+ok = (not d.get('error') and d.get('value', 0) > 0
+      and d.get('cache_misses_post_warmup') == 0
+      and drill.get('completed') == drill.get('n_requests')
+      and drill.get('bit_identical') is True)
+sys.exit(0 if ok else 1)"; then
+    say "serve smoke OK (journaled p50/p99 + zero cache misses + device_loss drill replayed in-flight requests)"
+else
+    say "SERVE SMOKE FAILED — continuous-batching path broken; fix before serving this window (journal: logs/serve_smoke_${FTS}.jsonl)"
+fi
+
 # 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
 # timing once turned a ~30 s case into a 600 s timeout. If a test suite is
 # mid-flight when the window opens, wait it out (bounded) instead of

@@ -296,6 +296,58 @@ def test_host_sync_scope_includes_run_and_supervisor():
         assert findings_for(ROOT / rel, "host-sync-in-hot-loop") == []
 
 
+def test_host_sync_scope_includes_serving_dispatch_loop(tmp_path):
+    """ISSUE 6 satellite: the serving subsystem's dispatch/load loops are
+    hot paths — a host sync per dispatched batch is a latency tax on every
+    request — so serving/{server,loadgen,batcher,queue}.py are in scope,
+    the shipped modules stay clean, and the @off_timed_path exemption
+    (journal writes / result slicing) works there exactly as it does for
+    the supervisor's screening."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        HostSyncInHotLoopRule,
+        _HOT_LOOP_FILES,
+    )
+
+    assert {"server.py", "loadgen.py", "batcher.py", "queue.py"} <= _HOT_LOOP_FILES
+    rule = HostSyncInHotLoopRule()
+    assert rule.applies(
+        Path("cuda_mpi_gpu_cluster_programming_tpu/serving/server.py")
+    )
+    for rel in (
+        "cuda_mpi_gpu_cluster_programming_tpu/serving/server.py",
+        "cuda_mpi_gpu_cluster_programming_tpu/serving/loadgen.py",
+        "cuda_mpi_gpu_cluster_programming_tpu/serving/batcher.py",
+        "cuda_mpi_gpu_cluster_programming_tpu/serving/queue.py",
+    ):
+        assert findings_for(ROOT / rel, "host-sync-in-hot-loop") == []
+    # a sync in a dispatch loop IS flagged in a serving-named file...
+    bad = tmp_path / "server.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def loop(batches, fwd):\n"
+        "    outs = []\n"
+        "    for b in batches:\n"
+        "        outs.append(np.asarray(fwd(b)))\n"
+        "    return outs\n"
+    )
+    assert len(findings_for(bad, "host-sync-in-hot-loop")) == 1
+    # ...and the same sync under @off_timed_path (journal/completion
+    # writes) is exempt, per the existing annotation contract.
+    ok = tmp_path / "loadgen.py"
+    ok.write_text(
+        "import numpy as np\n"
+        "from cuda_mpi_gpu_cluster_programming_tpu.resilience.sentinel "
+        "import off_timed_path\n"
+        "@off_timed_path\n"
+        "def complete(batches):\n"
+        "    outs = []\n"
+        "    for b in batches:\n"
+        "        outs.append(np.asarray(b))\n"
+        "    return outs\n"
+    )
+    assert findings_for(ok, "host-sync-in-hot-loop") == []
+
+
 def test_key_reuse_split_and_branches_ok(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(
