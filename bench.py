@@ -69,6 +69,14 @@ CONFIGS = [
 # Opt-in TunePlan (docs/TUNING.md): rows gain plan_hash + tuned_vs_default.
 PLAN_PATH = os.environ.get("BENCH_PLAN", "")
 COMPUTE = os.environ.get("BENCH_COMPUTE", "fp32")
+# Forced-precision rows (docs/PRECISION.md): BENCH_DTYPE pins the precision
+# policy (fp32|bf16|int8w) independently of the legacy BENCH_COMPUTE
+# spelling, so the fp32-vs-bf16-vs-int8w trajectory is machine-comparable
+# across BENCH_r* captures. Every JSON row carries "dtype" (what actually
+# ran), "plan_policy" (the persisted dtype-sweep winner at this point, ""
+# when none) and "gate_margin" (the tolerance-gate headroom recorded for
+# the row's dtype, null when ungated).
+DTYPE = os.environ.get("BENCH_DTYPE", "") or COMPUTE
 # 128 is the round-over-round comparable default (advisor: the round-3
 # bump to 256 raised the headline via configuration, not code — sweeps opt
 # into 256 explicitly via BENCH_BATCH). fp32 keeps the comparison to the
@@ -118,6 +126,7 @@ def _error_obj(msg: str, platform: str = "unknown", config: str = None) -> dict:
         "platform": platform,
         "config": config or CONFIG,
         "compute": COMPUTE,
+        "dtype": DTYPE,
         "batch": BATCH,
     }
     # The tunneled chip can wedge for hours (see logs/probe_attempts_r03.log);
@@ -199,19 +208,38 @@ def _child() -> int:
     peak = peak_tflops(device.device_kind)
 
     plan, plan_note = None, ""
+    plan_policy, gate_margins = "", {}
     if PLAN_PATH:
         # A requested-but-unusable plan is a visible note on every row, never
         # a silent fall-through to untuned numbers labeled tuned.
         try:
             from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
-            from cuda_mpi_gpu_cluster_programming_tpu.tuning.plan import load_plan
+            from cuda_mpi_gpu_cluster_programming_tpu.tuning.plan import (
+                load_plan,
+                load_policy,
+            )
 
             plan = load_plan(
                 PLAN_PATH, device_kind=device.device_kind, model_cfg=BLOCKS12,
-                dtype=COMPUTE, batch=BATCH,
+                dtype=DTYPE, batch=BATCH,
             )
             if plan is None:
                 plan_note = f"no matching plan in {PLAN_PATH} (untuned)"
+            # The persisted dtype-sweep winner + per-dtype gate margins at
+            # this point (docs/PRECISION.md): rows say which dtype the
+            # tuner would pick and how much oracle-tolerance headroom the
+            # row's own dtype was gated with.
+            rec = load_policy(
+                PLAN_PATH, device_kind=device.device_kind, model_cfg=BLOCKS12,
+                batch=BATCH,
+            )
+            if rec is not None:
+                plan_policy = rec.get("dtype", "")
+                gate_margins = {
+                    dt: g.get("margin")
+                    for dt, g in rec.get("gates", {}).items()
+                    if isinstance(g, dict)
+                }
         except Exception as e:
             plan_note = f"plan load failed: {type(e).__name__}: {e}"[:160]
 
@@ -251,6 +279,12 @@ def _child() -> int:
             "mfu": mfu,
             "fp32_ceiling_fraction": fp32_ceiling_frac,
             "compute": compute,
+            # The precision policy this row ACTUALLY measured (docs/
+            # PRECISION.md); gate_margin = oracle-tolerance headroom the
+            # dtype sweep recorded for it (null = no gated record).
+            "dtype": compute,
+            "plan_policy": plan_policy,
+            "gate_margin": gate_margins.get(compute),
             "per_pass_ms": round(st.per_call_ms, 4),
             "timing_n": st.n_samples,
             "timing_ci95_ms": round(st.ci95_ms, 4),
@@ -269,7 +303,7 @@ def _child() -> int:
         # error row and the sweep keeps going — one broken tier must not
         # erase the others' fresh measurements.
         try:
-            row = measure(COMPUTE, config=cfg_key)
+            row = measure(DTYPE, config=cfg_key)
         except Exception as e:
             print(
                 json.dumps(
@@ -317,7 +351,7 @@ def _child() -> int:
         # states the chip's actual capability, with its own MFU and n/CI
         # fields). Skipped when the primary already is bf16 or on CPU (no
         # second tier to show).
-        if COMPUTE == "fp32" and platform != "cpu" and os.environ.get("BENCH_BF16", "1") != "0":
+        if DTYPE == "fp32" and platform != "cpu" and os.environ.get("BENCH_BF16", "1") != "0":
             # Never let the optional secondary destroy the completed primary:
             # a bf16 failure (unsupported config, relay hiccup, mid-run
             # wedge) degrades to an error note, not a value:0.0 round record.
@@ -336,7 +370,7 @@ def _child() -> int:
         if cont and cont != BATCH and platform != "cpu" and len(CONFIGS) == 1:
             try:
                 out[f"continuity_b{cont}"] = {
-                    **measure(COMPUTE, batch=cont, config=cfg_key), "batch": cont
+                    **measure(DTYPE, batch=cont, config=cfg_key), "batch": cont
                 }
             except Exception as e:
                 out[f"continuity_b{cont}"] = {"error": f"{type(e).__name__}: {e}"[:200]}
@@ -416,6 +450,25 @@ def _serve_drill(model_cfg) -> dict:
     }
 
 
+def _plan_policy_for(model_cfg) -> str:
+    """The persisted dtype-sweep winner at this geometry/batch point, or ""
+    when no plan file is named / no record matches (never fatal)."""
+    if not PLAN_PATH:
+        return ""
+    try:
+        import jax
+
+        from cuda_mpi_gpu_cluster_programming_tpu.tuning.plan import load_policy
+
+        rec = load_policy(
+            PLAN_PATH, device_kind=jax.devices()[0].device_kind,
+            model_cfg=model_cfg, batch=BATCH,
+        )
+        return rec.get("dtype", "") if rec else ""
+    except Exception:
+        return ""
+
+
 def _serve_main() -> int:
     """BENCH_MODE=serve: one JSON row for a journaled Poisson serve run.
 
@@ -466,7 +519,7 @@ def _serve_main() -> int:
         scfg = ServeConfig(
             config=os.environ.get("BENCH_SERVE_CONFIG", CONFIG),
             n_shards=int(os.environ.get("BENCH_SERVE_SHARDS", "1")),
-            compute=COMPUTE,
+            compute=DTYPE,
             max_batch=int(os.environ.get("BENCH_SERVE_MAX_BATCH", "8")),
             plan_path=PLAN_PATH,
             supervise=os.environ.get("BENCH_SERVE_SUPERVISE", "1") != "0",
@@ -511,6 +564,11 @@ def _serve_main() -> int:
             "config": scfg.config,
             "shards": scfg.n_shards,
             "compute": scfg.compute,
+            # Same precision fields as the measure rows (docs/PRECISION.md):
+            # the policy the service actually ran, and the persisted
+            # dtype-sweep winner at this point when a plan file is named.
+            "dtype": scfg.compute,
+            "plan_policy": _plan_policy_for(model_cfg),
             "supervise": scfg.supervise,
             "platform": platform,
             "journal": journal_path,

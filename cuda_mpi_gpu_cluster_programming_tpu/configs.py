@@ -153,27 +153,42 @@ def build_forward(
     compute: str = "fp32",
     plan=None,
     donate: bool = False,
+    policy=None,
 ) -> Callable:
     """Return a jitted ``(params, x) -> out`` for the given execution config.
 
     ``model_cfg`` defaults per model family (BLOCKS12 / ALEXNET).
     ``n_shards`` is the TPU analogue of ``mpirun -np N``
     (scripts/common_test_utils.sh:274-276).
-    ``compute`` selects numerics: ``fp32`` (exact reference parity — fp32
-    MACs even on the MXU) or ``bf16`` (params+input cast to bfloat16, fp32
-    accumulation on the MXU, fp32 output — the TPU-native perf mode; halves
-    HBM traffic and engages the MXU's fast path. No reference analogue:
-    CUDA stages are fp32-only).
+    ``policy`` (or the legacy ``compute`` string, which accepts the same
+    names) selects numerics via the precision subsystem
+    (docs/PRECISION.md): ``fp32`` (exact reference parity — fp32 MACs even
+    on the MXU), ``bf16`` (params+input cast to bfloat16, fp32 accumulation
+    on the MXU, fp32 output — the TPU-native perf mode; halves HBM traffic
+    and engages the MXU's fast path), or ``int8w`` (symmetric per-channel
+    int8 weights, dequant-free bf16-accumulate compute — single-device
+    Blocks 1-2 tiers only). A ``precision.policy.DtypePolicy`` object is
+    accepted wherever a name is. Non-fp32 policies are expected to have
+    cleared the fp32-oracle ``ToleranceGate`` (the autotuner enforces this
+    before persisting a winner).
     ``plan``: a ``tuning.plan.TunePlan`` whose per-layer kernel variants the
-    Pallas tiers run with (reference tiers ignore it); explicit env knobs
-    still win — see docs/TUNING.md.
+    Pallas tiers run with (single-device AND sharded builders; reference
+    tiers ignore it); explicit env knobs still win — see docs/TUNING.md.
     ``donate``: donate the input-activation buffer to the computation
     (single-device tiers; halves peak HBM for the activation at the cost of
     consuming ``x`` — callers that re-invoke with the same array, e.g. the
     amortized timing chains, must leave this off).
     """
-    if compute not in ("fp32", "bf16"):
-        raise ValueError(f"unknown compute mode {compute!r} (fp32|bf16)")
+    from .precision.policy import POLICY_NAMES, resolve_policy
+
+    try:
+        pol = resolve_policy(policy if policy is not None else compute)
+    except ValueError:
+        raise ValueError(
+            f"unknown compute mode / precision policy "
+            f"{(policy if policy is not None else compute)!r} "
+            f"({'|'.join(POLICY_NAMES)})"
+        ) from None
     # Persistent XLA compile cache (the prebuilt-binaries analogue), wired
     # at build time so EVERY builder caller — tuner candidates included —
     # gets it, not just the run/bench entry mains. Never fatal: a read-only
@@ -184,8 +199,28 @@ def build_forward(
         enable_persistent_cache()
     except Exception:
         pass
+    if pol.quantized:
+        if exec_cfg.model != "blocks12" or exec_cfg.strategy != "single":
+            raise ValueError(
+                f"policy {pol.name!r} supports the single-device Blocks 1-2 "
+                f"tiers only (config {exec_cfg.key!r} is "
+                f"{exec_cfg.model}/{exec_cfg.strategy}); quantized sharded "
+                "forwards are an open ROADMAP item"
+            )
+        from .models.alexnet import BLOCKS12 as _B12
+        from .precision.quantize import forward_blocks12_int8w
+
+        mcfg = model_cfg or _B12
+        kv = _resolve_variants(plan) if exec_cfg.tier == "pallas" else None
+        tier = exec_cfg.tier
+        return _jit(
+            lambda p, x: forward_blocks12_int8w(
+                p, x, mcfg, variants=kv, tier=tier
+            ),
+            donate,
+        )
     fwd = _build_forward_fp32(exec_cfg, model_cfg, n_shards, mesh, plan, donate)
-    if compute == "fp32":
+    if pol.name == "fp32":
         return fwd
     import jax.numpy as jnp
 
@@ -246,6 +281,7 @@ def _build_forward_fp32(
                 mesh=mesh,
                 tier=exec_cfg.tier,
                 staged=(exec_cfg.strategy == "staged_halo"),
+                plan=plan,
             )
             # Row-sharded feature extractor; FC head on the gathered features
             # (replicated — the 6x6x256 activations are tiny next to conv1's).
@@ -281,6 +317,7 @@ def _build_forward_fp32(
             mesh=mesh,
             tier=exec_cfg.tier,
             staged=(exec_cfg.strategy == "staged_halo"),
+            plan=plan,
         )
 
     if exec_cfg.strategy == "tp":

@@ -68,6 +68,7 @@ CSV_COLUMNS = [
     "ResilienceMsg",
     "PlanHash",
     "SupervisorMsg",
+    "Dtype",
 ]
 
 # Exit-code triage classes (common_test_utils.sh:96-116); DEGRADED comes
@@ -217,6 +218,10 @@ _RE_PLAN = re.compile(r"^Tune plan: (?:cache|swept|loaded) hash=([0-9a-f]+)", re
 # (resilience.supervisor.Supervisor.summary): attempts/trips/degradations
 # plus the ladder rung that finally served the batch.
 _RE_SUPERVISOR = re.compile(r"^Supervisor: (.+)$", re.MULTILINE)
+# Precision-policy line printed by the run CLI (docs/PRECISION.md): the
+# dtype the run ACTUALLY measured under (an int8w/bf16 row must never be
+# read as fp32, and a tuned-winner adoption is visible per row).
+_RE_PRECISION = re.compile(r"^Precision: dtype=(\S+)", re.MULTILINE)
 
 
 def is_wedged(r: CaseResult, log_text: str) -> bool:
@@ -253,6 +258,7 @@ class CaseResult:
     degraded_msg: str = ""  # the run CLI's DEGRADED(from -> to) event line
     plan_hash: str = ""  # TunePlan identity the run measured under ("" = untuned)
     supervisor_msg: str = ""  # the run CLI's 'Supervisor: ...' incident line
+    dtype: str = ""  # precision policy the run measured under ("" = pre-policy log)
 
     @property
     def status(self) -> str:
@@ -394,6 +400,7 @@ class Session:
             r.resilience_msg or r.degraded_msg,
             r.plan_hash,
             r.supervisor_msg,
+            r.dtype,
         ]
         with open(self.csv_path, "a", newline="") as f:
             csv.writer(f).writerow(values)
@@ -429,6 +436,7 @@ def case_result_from_row(row: dict) -> CaseResult:
         resilience_msg=str(row.get("ResilienceMsg", "")),
         plan_hash=str(row.get("PlanHash", "")),
         supervisor_msg=str(row.get("SupervisorMsg", "")),
+        dtype=str(row.get("Dtype", "")),
     )
     if row.get("ExecutionTime_ms"):
         r.time_ms = float(row["ExecutionTime_ms"])
@@ -516,6 +524,9 @@ def _run_once(
         m = _RE_SUPERVISOR.search(text)
         if m:
             r.supervisor_msg = m.group(1)[:200]
+        m = _RE_PRECISION.search(text)
+        if m:
+            r.dtype = m.group(1)
     return text
 
 
@@ -671,7 +682,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--computes",
         default="fp32",
-        help="comma-separated compute modes to sweep (fp32,bf16)",
+        help="comma-separated precision policies to sweep "
+        "(fp32,bf16,int8w — docs/PRECISION.md)",
     )
     p.add_argument("--timeout", type=float, default=300.0, help="per-case timeout seconds")
     p.add_argument(
@@ -748,7 +760,7 @@ def main(argv=None) -> int:
     shard_counts = [int(s) for s in args.shards.split(",")]
     batches = [int(b) for b in args.batches.split(",")]
     computes = [c.strip() for c in args.computes.split(",") if c.strip()]
-    bad = [c for c in computes if c not in ("fp32", "bf16")]
+    bad = [c for c in computes if c not in ("fp32", "bf16", "int8w")]
     if bad:
         print(f"unknown compute modes: {bad}", file=sys.stderr)
         return 2
@@ -793,10 +805,10 @@ def main(argv=None) -> int:
                     # --oversubscribe semantics: with --fake-devices, grow the
                     # virtual mesh to fit np_ so every sweep point actually runs.
                     fake = max(args.fake_devices, np_) if args.fake_devices else 0
-                    # bf16 rows get a distinct variant name so the analysis
-                    # warehouse keeps the modes separate (analysis.md:69-92
-                    # canonical-name discipline).
-                    vname = variant if compute == "fp32" else f"{variant} bf16"
+                    # Non-fp32 rows get a distinct variant name so the
+                    # analysis warehouse keeps the modes separate
+                    # (analysis.md:69-92 canonical-name discipline).
+                    vname = variant if compute == "fp32" else f"{variant} {compute}"
                     # Full-AlexNet rows use seeded-random init: constant init
                     # is degenerate there (identical weights per channel ->
                     # all 1000 logits equal), so its printed first-5 verifies
@@ -828,7 +840,15 @@ def main(argv=None) -> int:
                         batch,
                         timeout_s=args.timeout,
                         fake_devices=fake,
-                        extra_args=extra + ["--compute", compute] + init_args,
+                        # int8w rides the policy flag (the legacy --compute
+                        # spelling stays fp32|bf16-only for old scripts).
+                        extra_args=extra
+                        + (
+                            ["--dtype", compute]
+                            if compute == "int8w"
+                            else ["--compute", compute]
+                        )
+                        + init_args,
                         # Distinct log file per compute mode — both sweeps of
                         # one (config, np, batch) point must keep their logs.
                         log_tag=compute if len(computes) > 1 else "",

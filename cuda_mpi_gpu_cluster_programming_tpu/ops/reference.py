@@ -29,6 +29,7 @@ def conv2d(
     stride: int,
     padding: int,
     precision: lax.PrecisionLike = lax.Precision.HIGHEST,
+    preferred_element_type=None,
 ) -> jax.Array:
     """Direct 2-D convolution (cross-correlation) with bias.
 
@@ -47,6 +48,11 @@ def conv2d(
     the reference's fp32 numerics on TPU, where the MXU's default precision
     would otherwise compute in bf16; perf-oriented configs pass
     ``lax.Precision.DEFAULT`` explicitly.
+
+    ``preferred_element_type`` pins the accumulation dtype — the precision
+    subsystem's mixed-dtype paths (bf16/int8w policies, precision.gate)
+    thread fp32 here so the accumulation width is stated, never inferred
+    (the staticcheck ``implicit-upcast`` contract).
     """
     out = lax.conv_general_dilated(
         x,
@@ -55,6 +61,7 @@ def conv2d(
         padding=[(padding, padding), (padding, padding)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         precision=precision,
+        preferred_element_type=preferred_element_type,
     )
     return out + b.astype(out.dtype)
 

@@ -101,6 +101,7 @@ def build_sharded_forward(
     tier: str = "reference",
     staged: bool = False,
     with_digests: bool = False,
+    plan=None,
 ) -> Callable:
     """Jitted ``(params, x) -> out`` running row-sharded over ``n_shards``.
 
@@ -114,10 +115,18 @@ def build_sharded_forward(
     are device scalars riding alongside the output: nothing syncs to host
     until a screener (``resilience.sentinel.StageDigests``) fetches them
     off the timed path, so the hot loop stays free of host round trips.
+
+    ``plan``: a ``tuning.plan.TunePlan`` — the pallas tier runs each conv
+    layer (and the pool it feeds) under the plan's per-layer winners, with
+    the same env > plan > default knob precedence as the single-device
+    builders (``tuning.plan.effective_layer_variants``). The ``fuse`` knob
+    does not apply on this path (the hvalid lowering has no fused epilogue
+    to hang an hpool stage off) and is ignored; reference tier ignores the
+    whole plan, as everywhere else.
     """
     mesh = mesh or make_mesh(n_shards, axis_name=AXIS)
     n = n_shards
-    plan = make_shard_plan(model_cfg, n)
+    splan = make_shard_plan(model_cfg, n)
     if with_digests:
         from ..resilience.sentinel import tree_digest
 
@@ -133,15 +142,34 @@ def build_sharded_forward(
         # vma-tagged out_shapes (ops.vma) let this shard_map keep
         # check_vma=True — previously the pallas tier forced the checker
         # off for the whole body, halo ppermutes included. Variants resolve
-        # eagerly at build time (same footgun fix as configs.build_forward).
+        # eagerly at build time (same footgun fix as configs.build_forward);
+        # a TunePlan overlays per-layer winners (env knobs still win).
         kv = KernelVariants.resolve()
-        conv_fn = functools.partial(
-            conv2d_pallas_hvalid, vma=(AXIS,), variant=kv.conv,
-            row_block=kv.row_block, k_block=kv.k_block
-        )
-        pool_fn = functools.partial(maxpool_pallas, vma=(AXIS,), variant=kv.pool)
+        lv = None
+        if plan is not None:
+            from ..tuning.plan import effective_layer_variants
+
+            lv = effective_layer_variants(plan, base=kv)
+
+        def _fns(v):
+            return (
+                functools.partial(
+                    conv2d_pallas_hvalid, vma=(AXIS,), variant=v.conv,
+                    row_block=v.row_block, k_block=v.k_block,
+                ),
+                functools.partial(maxpool_pallas, vma=(AXIS,), variant=v.pool),
+            )
+
+        # Per-layer kernel fns: a conv's tuned variants also govern the
+        # pool it feeds (same adjacency contract as _conv_then_pool).
+        layer_fns = {}
+        governing = kv
+        for lp in splan.layers:
+            if lp.kind == "conv":
+                governing = lv.for_layer(lp.name) if lv is not None else kv
+            layer_fns[lp.name] = _fns(governing)
     else:
-        conv_fn, pool_fn = _conv_hvalid, _pool_hvalid
+        layer_fns = None
 
     specs = dict(model_cfg.layer_chain())
 
@@ -149,7 +177,7 @@ def build_sharded_forward(
         # xb: (N, b0, W, C) — this shard's rows (zero-padded past H)
         cur = xb
         digs = {}
-        for lp in plan.layers:
+        for lp in splan.layers:
             spec = specs[lp.name]
             if lp.kind == "pointwise":
                 cur = ops.lrn(
@@ -161,6 +189,11 @@ def build_sharded_forward(
                     alpha_over_size=spec.alpha_over_size,
                 )
             else:
+                conv_fn, pool_fn = (
+                    layer_fns[lp.name]
+                    if layer_fns is not None
+                    else (_conv_hvalid, _pool_hvalid)
+                )
                 cur = _apply_spatial(
                     lp, cur, params, spec, AXIS, n, conv_fn, pool_fn, staged
                 )
@@ -174,7 +207,7 @@ def build_sharded_forward(
 
     out_spec = P(None, AXIS, None, None)
     if with_digests:
-        out_specs = (out_spec, {lp.name: P(AXIS) for lp in plan.layers})
+        out_specs = (out_spec, {lp.name: P(AXIS) for lp in splan.layers})
     else:
         out_specs = out_spec
     sharded = shard_map(
@@ -189,8 +222,8 @@ def build_sharded_forward(
         check_vma=(tier != "pallas" or kernel_check_vma()),
     )
 
-    h_pad = n * plan.layers[0].b_in  # SPMD needs equal blocks: pad H to n*b0
-    l_final = plan.l_final
+    h_pad = n * splan.layers[0].b_in  # SPMD needs equal blocks: pad H to n*b0
+    l_final = splan.l_final
 
     @jax.jit
     def fwd(params, x):

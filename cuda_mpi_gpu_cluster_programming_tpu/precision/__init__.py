@@ -1,0 +1,40 @@
+"""Precision subsystem: dtype policies, int8 weight quantization, and the
+fp32-oracle tolerance gate (docs/PRECISION.md).
+
+Makes compute precision a first-class, tuned, oracle-gated axis instead of
+a hand-pinned flag: ``policy`` names the per-layer dtype assignment
+(``fp32``/``bf16``/``int8w``), ``quantize`` implements symmetric
+per-output-channel int8 weights with a dequant-free bf16-accumulate
+forward, and ``gate`` screens every non-fp32 candidate against the fp32
+oracle before the autotuner may persist it as a winner."""
+
+from .gate import DEFAULT_BUDGETS, GateResult, StageBudget, ToleranceGate
+from .policy import (
+    POLICY_NAMES,
+    PRESETS,
+    DtypePolicy,
+    LayerPrecision,
+    resolve_policy,
+)
+from .quantize import (
+    dequantize,
+    forward_blocks12_int8w,
+    quantize_channelwise,
+    quantize_conv_params,
+)
+
+__all__ = [
+    "DEFAULT_BUDGETS",
+    "GateResult",
+    "StageBudget",
+    "ToleranceGate",
+    "POLICY_NAMES",
+    "PRESETS",
+    "DtypePolicy",
+    "LayerPrecision",
+    "resolve_policy",
+    "dequantize",
+    "forward_blocks12_int8w",
+    "quantize_channelwise",
+    "quantize_conv_params",
+]

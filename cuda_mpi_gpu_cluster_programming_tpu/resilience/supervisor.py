@@ -159,12 +159,16 @@ class Supervisor:
         if entry.strategy in ("halo", "staged_halo"):
             from ..parallel.sharded import build_sharded_forward
 
+            # plan= rides into the SHARDED pallas builder too (PR 5
+            # leftover closed): a degrade re-plan keeps its tuned per-layer
+            # variants instead of silently reverting to defaults.
             return build_sharded_forward(
                 cfg,
                 entry.n_shards,
                 tier=entry.tier,
                 staged=(entry.strategy == "staged_halo"),
                 with_digests=True,
+                plan=self.plan,
             )
         if entry.strategy == "tp":
             from ..parallel.tensor_parallel import build_tp_forward

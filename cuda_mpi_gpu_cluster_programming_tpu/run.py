@@ -48,7 +48,34 @@ def make_parser() -> argparse.ArgumentParser:
         "--compute",
         choices=["fp32", "bf16"],
         default="fp32",
-        help="fp32 = exact reference-parity numerics; bf16 = MXU fast path",
+        help="fp32 = exact reference-parity numerics; bf16 = MXU fast path "
+        "(legacy spelling; --dtype/--policy supersede it when given)",
+    )
+    p.add_argument(
+        "--dtype",
+        choices=["", "fp32", "bf16", "int8w"],
+        default="",
+        help="force a precision policy for this run (docs/PRECISION.md): "
+        "fp32 = reference floor, bf16 = MXU fast path, int8w = per-channel "
+        "int8 weights with dequant-free bf16-accumulate compute. With "
+        "--tune, pins the dtype sweep to this single dtype",
+    )
+    p.add_argument(
+        "--policy",
+        choices=["", "tuned", "fp32", "bf16", "int8w"],
+        default="",
+        help="named precision-policy selection: 'tuned' runs the winning "
+        "dtype of the persisted dtype sweep (the plan file's policy "
+        "record; falls back to --compute with a visible note when none "
+        "matches); a preset name behaves like --dtype. Mutually exclusive "
+        "with --dtype",
+    )
+    p.add_argument(
+        "--gate-journal",
+        default="",
+        help="with --tune: journal every tolerance-gate verdict "
+        "(gate_pass/gate_fail records) to this jsonl path; default: "
+        "<plan>_gate.jsonl next to the plan file (docs/PRECISION.md)",
     )
     p.add_argument(
         "--lrn-form",
@@ -247,44 +274,117 @@ def main(argv=None) -> int:
     print(f"Devices: {jax.device_count()} x {jax.devices()[0].device_kind} "
           f"({jax.default_backend()})")
 
+    # Precision-policy resolution (docs/PRECISION.md): an explicit --dtype
+    # (or preset --policy) pins the run; --policy tuned reads the persisted
+    # dtype-sweep winner; plain --tune adopts the sweep winner; otherwise
+    # the legacy --compute flag stands. The "Precision:" line below is
+    # machine-parsed (harness._RE_PRECISION) into the CSV's Dtype column.
+    if args.dtype and args.policy:
+        print("--dtype and --policy are mutually exclusive", file=sys.stderr)
+        return 2
+    pinned = args.dtype or (args.policy if args.policy not in ("", "tuned") else "")
+    run_dtype = pinned or args.compute
+    dtype_source = "dtype" if args.dtype else ("policy" if pinned else "compute")
+    gate_info = None
+
     # Kernel-variant tuning plan: --tune sweeps (or loads the cached sweep),
     # --plan alone loads; either way the resolved plan rides into
     # build_forward and its hash is printed for the harness CSV. The
     # "Tune plan:" line is part of the machine-parsed stdout contract
     # (harness._RE_PLAN).
     plan = None
-    if args.tune or args.plan:
+    if args.tune or args.plan or args.policy == "tuned":
         from pathlib import Path
 
         from .resilience.policy import Deadline as _Deadline
-        from .tuning.autotune import autotune
-        from .tuning.plan import load_plan
+        from .tuning.autotune import DTYPES, autotune, autotune_precision
+        from .tuning.plan import load_plan, load_policy
 
         plan_path = args.plan or str(
             Path(__file__).resolve().parent.parent / "perf" / "tune_plan.json"
         )
         device_kind = jax.devices()[0].device_kind
-        if args.tune:
-            plan, cached = autotune(
-                plan_path,
-                model_cfg,
-                dtype=args.compute,
+        if args.policy == "tuned" and not args.tune:
+            rec = load_policy(
+                plan_path, device_kind=device_kind, model_cfg=model_cfg,
                 batch=args.batch,
-                force=args.tune_force,
-                deadline=_Deadline.after(args.deadline_s or None),
-                repeats=args.tune_repeats,
-                warmup=args.tune_warmup,
-                device_kind=device_kind,
             )
-            print(
-                f"Tune plan: {'cache' if cached else 'swept'} "
-                f"hash={plan.plan_hash()} key={plan.key} path={plan_path}"
-                + (f" DEGRADED({plan.degraded})" if plan.degraded else "")
-            )
-        else:
+            if rec is None:
+                print(
+                    f"Policy: no tuned dtype record in {plan_path} "
+                    f"(falling back to --compute {args.compute}; "
+                    "run --tune to sweep)"
+                )
+            else:
+                run_dtype = rec["dtype"]
+                dtype_source = "tuned"
+                gate_info = rec.get("gates", {}).get(run_dtype)
+        if args.tune:
+            if exec_cfg.model == "blocks12":
+                # ONE sweep covers {fp32, bf16, int8w} x kernel variants per
+                # conv layer; gate-failed dtypes are pruned attributably and
+                # the winner's policy record is persisted (docs/PRECISION.md).
+                res = None
+                try:
+                    res = autotune_precision(
+                        plan_path,
+                        model_cfg,
+                        batch=args.batch,
+                        dtypes=(run_dtype,) if pinned else DTYPES,
+                        force=args.tune_force,
+                        deadline=_Deadline.after(args.deadline_s or None),
+                        repeats=args.tune_repeats,
+                        warmup=args.tune_warmup,
+                        device_kind=device_kind,
+                        gate_journal=args.gate_journal,
+                        seed=args.seed,
+                    )
+                except RuntimeError as e:
+                    # Every requested dtype gate-pruned (possible only for a
+                    # pinned sweep, or a broken fp32 oracle): say so and run
+                    # the forced dtype untuned — the gate blocks PERSISTED
+                    # winners, not explicitly forced runs.
+                    print(f"Gate pruned: {e}")
+                if res is not None:
+                    for dt, why in sorted(res.pruned.items()):
+                        print(f"Gate pruned: {dt} ({why})")
+                    if not pinned:
+                        run_dtype = res.winner
+                        dtype_source = "tuned"
+                    gate_info = res.gates.get(run_dtype)
+                    plan = res.plans.get(run_dtype)
+                if plan is not None:
+                    print(
+                        f"Tune plan: {'cache' if res.cached else 'swept'} "
+                        f"hash={plan.plan_hash()} key={plan.key} path={plan_path}"
+                        + (f" DEGRADED({plan.degraded})" if plan.degraded else "")
+                    )
+                else:
+                    print(
+                        f"Tune plan: none for dtype {run_dtype} "
+                        "(gate-pruned; untuned defaults)"
+                    )
+            else:
+                plan, cached = autotune(
+                    plan_path,
+                    model_cfg,
+                    dtype=run_dtype,
+                    batch=args.batch,
+                    force=args.tune_force,
+                    deadline=_Deadline.after(args.deadline_s or None),
+                    repeats=args.tune_repeats,
+                    warmup=args.tune_warmup,
+                    device_kind=device_kind,
+                )
+                print(
+                    f"Tune plan: {'cache' if cached else 'swept'} "
+                    f"hash={plan.plan_hash()} key={plan.key} path={plan_path}"
+                    + (f" DEGRADED({plan.degraded})" if plan.degraded else "")
+                )
+        else:  # --plan and/or --policy tuned: load, never sweep
             plan = load_plan(
                 plan_path, device_kind=device_kind, model_cfg=model_cfg,
-                dtype=args.compute, batch=args.batch,
+                dtype=run_dtype, batch=args.batch,
             )
             if plan is None:
                 print(
@@ -293,6 +393,24 @@ def main(argv=None) -> int:
                 )
             else:
                 print(f"Tune plan: loaded hash={plan.plan_hash()} key={plan.key}")
+            if gate_info is None:
+                rec = load_policy(
+                    plan_path, device_kind=device_kind, model_cfg=model_cfg,
+                    batch=args.batch,
+                )
+                if rec is not None:
+                    gate_info = rec.get("gates", {}).get(run_dtype)
+
+    if run_dtype == "fp32":
+        gate_str = "ref"  # fp32 IS the oracle: nothing to gate against
+    elif isinstance(gate_info, dict):
+        margin = gate_info.get("margin")
+        gate_str = ("pass" if gate_info.get("passed") else "fail") + (
+            f" margin={margin:.4f}" if isinstance(margin, (int, float)) else ""
+        )
+    else:
+        gate_str = "none"
+    print(f"Precision: dtype={run_dtype} source={dtype_source} gate={gate_str}")
 
     if exec_cfg.model == "alexnet_full":
         from .models.alexnet_full import init_full_deterministic, init_full_random
@@ -338,7 +456,11 @@ def main(argv=None) -> int:
         scfg = ServeConfig(
             config=args.config,
             n_shards=args.shards,
-            compute=args.compute,
+            # The resolved precision policy rides into serving whole: the
+            # bucket set derives from the plan at THIS dtype and every
+            # warmup compile runs it (docs/SERVING.md).
+            compute=run_dtype,
+            policy=dtype_source,
             max_batch=args.serve_max_batch,
             buckets=buckets or None,
             plan_path=args.plan,
@@ -427,7 +549,7 @@ def main(argv=None) -> int:
         cfg = REGISTRY[key]
         _chaos_build_faults(cfg)
         f = build_forward(
-            cfg, model_cfg, n_shards=args.shards, compute=args.compute, plan=plan
+            cfg, model_cfg, n_shards=args.shards, policy=run_dtype, plan=plan
         )
         t0 = time.perf_counter()
         jax.block_until_ready(f(params, x))
@@ -484,7 +606,7 @@ def main(argv=None) -> int:
         # Historical fast path, byte-identical stdout/stderr.
         try:
             fwd = build_forward(
-                exec_cfg, model_cfg, n_shards=args.shards, compute=args.compute,
+                exec_cfg, model_cfg, n_shards=args.shards, policy=run_dtype,
                 plan=plan,
             )
         except (ValueError, NotImplementedError, ModuleNotFoundError) as e:
@@ -567,7 +689,13 @@ def main(argv=None) -> int:
         + (" SHADOWED" if st.shadowed else "")
         + (" UNDERCONVERGED" if st.underconverged else "")
     )
-    if args.breakdown:
+    if args.breakdown and run_dtype == "int8w":
+        print(
+            "--breakdown does not support the int8w policy "
+            "(the quantized lowering has no per-layer XLA-tier analogue); "
+            "skipped"
+        )
+    elif args.breakdown:
         from .utils.profiling import layer_breakdown
 
         # Per-layer costs (the per-phase breakdown the reference lists as
@@ -580,7 +708,7 @@ def main(argv=None) -> int:
             model_cfg,
             repeats=max(1, args.repeats),
             warmup=n_small,
-            compute=args.compute,
+            compute=run_dtype,
             tier=exec_cfg.tier,
         ):
             shape_s = "x".join(str(d) for d in shape[1:])
@@ -595,7 +723,7 @@ def main(argv=None) -> int:
             from .parallel.breakdown import comm_compute_breakdown, format_table
 
             staged = exec_cfg.strategy == "staged_halo"
-            dtype_bytes = 2 if args.compute == "bf16" else 4
+            dtype_bytes = 2 if run_dtype in ("bf16", "int8w") else 4
             rows = comm_compute_breakdown(
                 blocks_cfg, args.shards, batch=args.batch,
                 dtype_bytes=dtype_bytes, staged=staged,
@@ -608,7 +736,7 @@ def main(argv=None) -> int:
             # jaxpr per primitive in tests/test_breakdown.py.
             from .parallel.breakdown import format_table, tp_comm_compute_breakdown
 
-            dtype_bytes = 2 if args.compute == "bf16" else 4
+            dtype_bytes = 2 if run_dtype in ("bf16", "int8w") else 4
             rows = tp_comm_compute_breakdown(
                 blocks_cfg, args.shards, batch=args.batch, dtype_bytes=dtype_bytes,
             )

@@ -49,7 +49,13 @@ class ServeConfig:
 
     config: str = "v1_jit"  # configs.REGISTRY key (blocks12 family)
     n_shards: int = 1
+    # The precision policy the service runs (and warms, and derives its
+    # tuned bucket set at): a policy name — fp32 | bf16 | int8w
+    # (docs/PRECISION.md). ``policy`` records HOW it was chosen
+    # (compute|dtype|policy|tuned — the run CLI's Precision source token)
+    # so journals/bench rows stay attributable.
     compute: str = "fp32"
+    policy: str = ""
     max_batch: int = 8
     # None = powers of two up to max_batch, or the TunePlan-derived set
     # when plan_path names a plan covering this point (tuning.plan_batches).
@@ -202,7 +208,7 @@ class InferenceServer:
             self._warmed.add(bucket)
             self._journal(
                 "serve_warm", key=f"warm:b{bucket}", bucket=bucket,
-                ms=round(ms, 3),
+                ms=round(ms, 3), dtype=self.cfg.compute,
             )
 
     def _rewarm(self, entry) -> None:

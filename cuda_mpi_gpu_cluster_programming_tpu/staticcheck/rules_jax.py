@@ -41,6 +41,15 @@ gate that cries wolf gets ``# noqa``'d into uselessness.
                          whole body (ops.vma exists so kernels can keep it
                          ON; a deliberate disable documents itself with
                          ``# noqa: check-vma-disabled <reason>``).
+  implicit-upcast      — a dot/conv contraction primitive in a hot-path
+                         module (ops/, models/, parallel/, precision/)
+                         fed a bf16/int8-cast operand with no explicit
+                         ``preferred_element_type``: the accumulation
+                         dtype is then whatever XLA infers, which differs
+                         across backends and silently changes numerics —
+                         the precision subsystem's contract
+                         (docs/PRECISION.md) is that mixed-precision
+                         contractions STATE their accumulation width.
 """
 
 from __future__ import annotations
@@ -613,6 +622,125 @@ class JitInLoopRule(Rule):
                     )
                 )
         return out
+
+
+# ---------------------------------------------------------------------------
+# implicit-upcast
+
+
+# Low-precision dtype names as they appear in astype targets (jnp.bfloat16,
+# "bfloat16", np.int8, ...). fp8 spellings included for forward-compat.
+_LOW_PRECISION_DTYPES = {
+    "bfloat16", "bf16", "float16", "fp16", "int8", "int4",
+    "float8_e4m3fn", "float8_e5m2",
+}
+# Contraction PRIMITIVES whose accumulation dtype preferred_element_type
+# pins. Deliberately excludes repo wrappers (conv2d_pallas & co) — those
+# state their accumulation internally.
+_UPCAST_CONTRACTIONS = {
+    "dot", "dot_general", "matmul", "einsum", "tensordot",
+    "conv_general_dilated",
+}
+_UPCAST_ROOTS = {"jnp", "lax", "jax", "np", "numpy"}
+_HOT_PATH_DIRS = {"ops", "models", "parallel", "precision"}
+
+
+def _low_cast_dtype(node: ast.expr) -> Optional[str]:
+    """'bfloat16' when node is ``<expr>.astype(<low-precision dtype>)``."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and node.args
+    ):
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value if a.value in _LOW_PRECISION_DTYPES else None
+        name = _terminal_attr(a)
+        if name in _LOW_PRECISION_DTYPES:
+            return name
+    return None
+
+
+@register
+class ImplicitUpcastRule(Rule):
+    code = "implicit-upcast"
+
+    def applies(self, path: Path) -> bool:
+        return bool(_HOT_PATH_DIRS & set(path.parts[:-1]))
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        for scope in scopes:
+            out.extend(self._check_scope(ctx, scope))
+        return out
+
+    @staticmethod
+    def _scope_nodes(scope: ast.AST):
+        """Nodes of ONE scope's body, not descending into nested function
+        defs/lambdas (those are their own scopes with their own casts)."""
+        stack = list(scope.body if isinstance(scope.body, list) else [scope.body])
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope(self, ctx: FileContext, scope: ast.AST) -> List[Finding]:
+        # Names bound to a low-precision cast anywhere in THIS scope (flow-
+        # insensitive but cast-anchored: only operands traceable to an
+        # explicit .astype(bf16/int8/...) are judged — plain arrays whose
+        # dtype we cannot know statically stay silent).
+        casts: dict = {}
+        for sub in self._scope_nodes(scope):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                dt = _low_cast_dtype(sub.value)
+                t = sub.targets[0]
+                if dt and isinstance(t, ast.Name):
+                    casts[t.id] = dt
+        findings: List[Finding] = []
+        for sub in self._scope_nodes(scope):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _terminal_attr(sub.func)
+            if name not in _UPCAST_CONTRACTIONS:
+                continue
+            if isinstance(sub.func, ast.Attribute):
+                if _root_name(sub.func) not in _UPCAST_ROOTS:
+                    continue
+            elif name not in ctx.mod.imports:
+                continue  # a bare local helper named `dot` etc.
+            if any(kw.arg == "preferred_element_type" for kw in sub.keywords):
+                continue
+            low = set()
+            for arg in sub.args:
+                dt = _low_cast_dtype(arg)
+                if dt is None and isinstance(arg, ast.Name):
+                    dt = casts.get(arg.id)
+                if dt:
+                    low.add(dt)
+            if not low:
+                continue
+            findings.append(
+                self.finding(
+                    ctx, sub.lineno,
+                    f"{name}(...) contracts over "
+                    f"{'/'.join(sorted(low))}-cast operands without an "
+                    "explicit preferred_element_type — the accumulation "
+                    "dtype is whatever XLA infers (backend-dependent "
+                    "numerics); state it "
+                    "(preferred_element_type=jnp.float32) or document "
+                    "the inference with # noqa: implicit-upcast",
+                    span=(sub.lineno, getattr(sub, "end_lineno", sub.lineno)),
+                )
+            )
+        return findings
 
 
 # ---------------------------------------------------------------------------

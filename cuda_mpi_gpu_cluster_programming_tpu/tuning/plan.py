@@ -57,8 +57,15 @@ VARIANT_ENV = {
 }
 
 # Sources whose drift invalidates tuned winners: the kernels themselves,
-# the model chain that decides fusion adjacency, and the candidate space.
-_REV_FILES = ("../ops/pallas_kernels.py", "../ops/pallas_model.py", "space.py")
+# the model chain that decides fusion adjacency, the candidate space, and
+# the quantized lowering (an int8w plan tuned against a different rescale
+# path is as stale as one tuned against different kernels).
+_REV_FILES = (
+    "../ops/pallas_kernels.py",
+    "../ops/pallas_model.py",
+    "space.py",
+    "../precision/quantize.py",
+)
 
 
 def code_rev() -> str:
@@ -101,7 +108,7 @@ class TunePlan:
     device_kind: str
     shape_key: str
     batch: int
-    dtype: str  # "fp32" | "bf16"
+    dtype: str  # a precision policy name: "fp32" | "bf16" | "int8w"
     code_rev: str
     layers: Tuple[Tuple[str, KernelVariants], ...]
     stats: Dict[str, dict] = dataclasses.field(default_factory=dict)
@@ -159,34 +166,48 @@ class TunePlan:
         )
 
 
-def _read_plans(path) -> dict:
+def _read_file(path) -> dict:
+    """The whole plan file as a dict (``plans`` + the sibling ``policies``
+    section); a missing/torn file degrades to empty sections."""
     try:
         with open(path) as f:
             obj = json.load(f)
     except (OSError, ValueError):
-        return {}
-    if not isinstance(obj, dict) or not isinstance(obj.get("plans"), dict):
-        return {}
-    return obj["plans"]
+        obj = {}
+    if not isinstance(obj, dict):
+        obj = {}
+    if not isinstance(obj.get("plans"), dict):
+        obj["plans"] = {}
+    if not isinstance(obj.get("policies"), dict):
+        obj["policies"] = {}
+    obj["version"] = PLAN_VERSION
+    return obj
 
 
-def save_plan(plan: TunePlan, path) -> str:
-    """Merge one plan into the file under its key (read-modify-write; other
-    device/dtype/batch points are preserved). Returns the key written."""
-    path = Path(path)
-    plans = _read_plans(path)
-    entry = plan.to_obj()
-    entry["created"] = datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%MZ"
-    )
-    plans[plan.key] = entry
+def _read_plans(path) -> dict:
+    return _read_file(path)["plans"]
+
+
+def _write_file(path, obj: dict) -> None:
     # Atomic replace: the plan cache is a committed run artifact; a crash
     # mid-save must leave the previous (complete) file, never a torn one.
     from ..resilience.journal import atomic_write_text
 
-    atomic_write_text(
-        path, json.dumps({"version": PLAN_VERSION, "plans": plans}, indent=2) + "\n"
+    atomic_write_text(path, json.dumps(obj, indent=2) + "\n")
+
+
+def save_plan(plan: TunePlan, path) -> str:
+    """Merge one plan into the file under its key (read-modify-write; other
+    device/dtype/batch points AND the policy records are preserved).
+    Returns the key written."""
+    path = Path(path)
+    obj = _read_file(path)
+    entry = plan.to_obj()
+    entry["created"] = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%MZ"
     )
+    obj["plans"][plan.key] = entry
+    _write_file(path, obj)
     return plan.key
 
 
@@ -261,6 +282,85 @@ def plan_batches(
         except (KeyError, TypeError, ValueError):
             continue  # malformed entry: not a usable bucket, skip it
     return sorted(batches)
+
+
+def policy_key(device_kind: str, shape_k: str, batch: int, rev: str) -> str:
+    """Key of a dtype-policy record — the plan key WITHOUT the dtype field
+    (the record's whole job is to say which dtype won at this point)."""
+    return f"{device_kind}|{shape_k}|b{batch}|rev={rev}"
+
+
+def save_policy(
+    path,
+    *,
+    device_kind: str,
+    model_cfg,
+    batch: int,
+    dtype: str,
+    rev: Optional[str] = None,
+    swept=(),
+    pruned: Optional[Dict[str, str]] = None,
+    gates: Optional[Dict[str, dict]] = None,
+) -> str:
+    """Persist the dtype-sweep winner for one (device, geometry, batch,
+    code-rev) point into the plan file's ``policies`` section (sibling of
+    ``plans``; the per-dtype kernel winners stay under their own keys).
+
+    ``pruned`` records every gate-failed dtype with its attributable
+    reason; ``gates`` the full per-dtype gate verdicts (margin and all) —
+    bench rows read ``gate_margin`` from here. The gate's journaled
+    ``gate_pass`` record is written by the gate itself at screening time;
+    this record points at the same verdict."""
+    path = Path(path)
+    obj = _read_file(path)
+    rev = rev or code_rev()
+    key = policy_key(device_kind, shape_key(model_cfg), batch, rev)
+    obj["policies"][key] = {
+        "device_kind": device_kind,
+        "shape_key": shape_key(model_cfg),
+        "batch": batch,
+        "code_rev": rev,
+        "dtype": dtype,
+        "swept": list(swept),
+        "pruned": dict(pruned or {}),
+        "gates": dict(gates or {}),
+        "created": datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%MZ"
+        ),
+    }
+    _write_file(path, obj)
+    return key
+
+
+def load_policy(
+    path,
+    *,
+    device_kind: str,
+    model_cfg,
+    batch: int,
+    rev: Optional[str] = None,
+    match_any_batch: bool = True,
+) -> Optional[dict]:
+    """The dtype-policy record for this point, or None. Same staleness and
+    nearest-batch semantics as ``load_plan``: a different code_rev is a
+    MISS, and with ``match_any_batch`` a same-device/geometry record tuned
+    at another batch is the nearest usable point."""
+    policies = _read_file(path)["policies"]
+    if not policies:
+        return None
+    rev = rev or code_rev()
+    sk = shape_key(model_cfg)
+    exact = policies.get(policy_key(device_kind, sk, batch, rev))
+    if exact is not None:
+        return exact
+    if not match_any_batch:
+        return None
+    prefix = f"{device_kind}|{sk}|b"
+    suffix = f"|rev={rev}"
+    for key in sorted(policies):
+        if key.startswith(prefix) and key.endswith(suffix):
+            return policies[key]
+    return None
 
 
 def effective_layer_variants(

@@ -99,10 +99,21 @@ def conv_geometries(model_cfg) -> List[ConvGeometry]:
     return out
 
 
-def prune_reason(v: KernelVariants, g: ConvGeometry, *, interpret: bool) -> str:
+def prune_reason(
+    v: KernelVariants, g: ConvGeometry, *, interpret: bool, dtype: str = "fp32"
+) -> str:
     """Why this combo is out of the sweep ('' = legal). Mirrors the gates in
     _conv2d_pallas / _conv_then_pool — a candidate this accepts must lower
-    and run the variant it claims."""
+    and run the variant it claims. ``dtype`` is the sweep's precision
+    policy: int8w runs the conv with the fused bias/ReLU epilogue disabled
+    (the per-channel rescale lands between accumulation and bias —
+    precision.quantize), so epilogue fusion is not a legal candidate
+    there."""
+    if v.fuse == "hpool" and dtype == "int8w":
+        return (
+            "hpool fusion needs the in-kernel bias/ReLU epilogue; int8w "
+            "applies bias after the per-channel rescale (precision.quantize)"
+        )
     if v.conv == "pairs" and g.fq < 2:
         return f"pairs degenerates to taps at fq={g.fq} (nothing to pair)"
     if v.conv == "g8" and g.stride < 2:
@@ -150,10 +161,13 @@ def candidate_space(
     g: ConvGeometry,
     *,
     interpret: bool,
+    dtype: str = "fp32",
     on_prune: Optional[Callable[[KernelVariants, str], None]] = None,
 ) -> List[KernelVariants]:
     """Every legal, effectively-distinct candidate for this layer, each
-    bound to the layer's K so logs/plans are self-labeling."""
+    bound to the layer's K so logs/plans are self-labeling. ``dtype``: the
+    sweep's precision policy (int8w excludes epilogue fusion — see
+    prune_reason)."""
     seen: set = set()
     out: List[KernelVariants] = []
     for conv, pool, rb, kb, fuse in itertools.product(
@@ -163,7 +177,7 @@ def candidate_space(
             conv=conv, pool=pool, row_block=rb, k_block=kb, fuse=fuse,
             k_channels=g.out_channels,
         )
-        why = prune_reason(v, g, interpret=interpret)
+        why = prune_reason(v, g, interpret=interpret, dtype=dtype)
         if not why:
             sig = _effective_signature(v, g)
             if sig in seen:

@@ -170,16 +170,59 @@ fi
 
 [ "${1:-}" = "--quick" ] && { say "quick mode: done"; exit 0; }
 
-say "kernel autotune + tuned headline (plan cached in perf/tune_plan.json; docs/TUNING.md)"
-# The sweep runs ONCE per (dtype, batch, code-rev) point — later heal
-# windows hit the plan cache and go straight to the tuned measurement.
-# --deadline-s bounds the sweep: expiry degrades to the default plan
-# (visibly) instead of eating the window.
+say "precision tolerance gate on-chip (docs/PRECISION.md: no non-fp32 headline without a gate_pass)"
+# The fp32-oracle gate runs BEFORE any tuned non-fp32 capture: a chip whose
+# bf16/int8w path deviates beyond budget (SDC, broken lowering, bad relay
+# state) must not publish a tuned-bf16 headline row this window. Verdicts
+# are journaled (gate_pass/gate_fail, fsync'd) next to the other artifacts.
+GATE_JOURNAL=logs/gate_${FTS}.jsonl
+GATE_BF16_OK=0
+if timeout 600 python - "$GATE_JOURNAL" >>"$LOG" 2>&1 <<'EOF'
+import sys
+import jax
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    init_params_random, random_input)
+from cuda_mpi_gpu_cluster_programming_tpu.precision import ToleranceGate
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+
+kp, kx = jax.random.split(jax.random.PRNGKey(0))
+params, x = init_params_random(kp), random_input(kx, 2)
+gate = ToleranceGate(journal=Journal(sys.argv[1]))
+verdicts = {p: gate.screen(p, params, x) for p in ("bf16", "int8w")}
+for p, r in verdicts.items():
+    print(f"gate {p}: {'PASS' if r.passed else 'FAIL'} "
+          f"margin={r.margin:.4f} {r.reason()}")
+sys.exit(0 if verdicts["bf16"].passed else 1)
+EOF
+then
+    GATE_BF16_OK=1
+    say "tolerance gate OK on chip (bf16 within budget vs the fp32 oracle; journal: $GATE_JOURNAL)"
+else
+    say "TOLERANCE GATE FAILED for bf16 on chip — tuned-bf16 headline capture REFUSED this window (journal: $GATE_JOURNAL)"
+fi
+
+say "kernel autotune + tuned headline (dtype-swept plan cached in perf/tune_plan.json; docs/TUNING.md + docs/PRECISION.md)"
+# ONE --tune now sweeps {fp32, bf16, int8w} x kernel variants and persists
+# the winning dtype policy; later heal windows hit the plan+policy cache
+# and go straight to the tuned measurement. --deadline-s bounds the sweep:
+# expiry degrades to the default plan (visibly) instead of eating the
+# window. bf16 rows are gate-checked above: a failed gate skips the bf16
+# capture entirely rather than publishing an unverified row.
+timeout 3600 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+    --config v3_pallas --batch 128 --repeats 100 \
+    --tune --plan perf/tune_plan.json --deadline-s 2700 \
+    --gate-journal "$GATE_JOURNAL" 2>&1 \
+    | grep -E "Tune plan|Precision|Gate pruned|tune dtype|completed in|DEGRADED" \
+    | sed "s/^/tuned sweep /" | tee -a "$LOG"
 for comp in bf16 fp32; do
-    timeout 2400 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
-        --config v3_pallas --batch 128 --compute $comp --repeats 100 \
-        --tune --plan perf/tune_plan.json --deadline-s 1800 2>&1 \
-        | grep -E "Tune plan|completed in|DEGRADED" \
+    if [ "$comp" = bf16 ] && [ "$GATE_BF16_OK" != 1 ]; then
+        say "tuned bf16 row SKIPPED (gate failed; fp32 reference floor still captured)"
+        continue
+    fi
+    timeout 1200 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+        --config v3_pallas --batch 128 --dtype $comp --repeats 100 \
+        --plan perf/tune_plan.json 2>&1 \
+        | grep -E "Tune plan|Precision|completed in|DEGRADED" \
         | sed "s/^/tuned $comp /" | tee -a "$LOG"
 done
 # Tuned-vs-default bench rows (one JSON row per config, each carrying

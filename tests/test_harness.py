@@ -161,14 +161,18 @@ def test_session_csv_schema(tmp_path):
     assert rows[0] == harness.CSV_COLUMNS
     # The reference's 20-column schema + the 2 resilience attempt-metadata
     # columns + the tuning PlanHash column + the supervisor incident column
-    # (each appended, so historical column indexes are untouched).
-    assert len(rows[0]) == 24
-    assert rows[0][20:] == ["Attempts", "ResilienceMsg", "PlanHash", "SupervisorMsg"]
+    # + the precision Dtype column (each appended, so historical column
+    # indexes are untouched).
+    assert len(rows[0]) == 25
+    assert rows[0][20:] == [
+        "Attempts", "ResilienceMsg", "PlanHash", "SupervisorMsg", "Dtype",
+    ]
     assert rows[1][4] == "V1 Serial"
     assert rows[1][14] == harness.OK
     assert rows[1][20] == "1"  # single attempt, no retries
     assert rows[1][22] == ""  # untuned row: no plan hash
     assert rows[1][23] == ""  # unsupervised row: no incident trail
+    assert rows[1][24] == ""  # no Precision line parsed: pre-policy log
 
 
 def test_run_case_subprocess_sweep(tmp_path):

@@ -417,6 +417,87 @@ def test_check_vma_computed_value_ok(tmp_path):
     assert findings_for(p, "check-vma-disabled") == []
 
 
+def test_implicit_upcast_triggers_in_hot_path_dirs(tmp_path):
+    """ISSUE 7 satellite: a contraction over bf16/int8-cast operands with
+    no explicit preferred_element_type, in a hot-path module, is flagged —
+    inline casts and name-bound casts alike."""
+    d = tmp_path / "ops"
+    d.mkdir()
+    p = d / "hot.py"
+    p.write_text(
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def mix(x, w):\n"
+        "    return jnp.dot(x.astype(jnp.bfloat16), w)\n"
+        "def bound(x, w):\n"
+        "    xb = x.astype(jnp.int8)\n"
+        "    return lax.dot_general(xb, w, (((1,), (0,)), ((), ())))\n"
+    )
+    found = findings_for(p, "implicit-upcast")
+    assert [f.line for f in found] == [4, 7]
+    assert all("preferred_element_type" in f.message for f in found)
+
+
+def test_implicit_upcast_explicit_accumulate_ok(tmp_path):
+    """Stating the accumulation dtype (the precision-subsystem contract)
+    silences the rule; fp32-only contractions are never judged."""
+    d = tmp_path / "precision"
+    d.mkdir()
+    p = d / "quantize.py"
+    p.write_text(
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def stated(x, w):\n"
+        "    return jnp.dot(x.astype(jnp.bfloat16), w,\n"
+        "                   preferred_element_type=jnp.float32)\n"
+        "def fp32_only(x, w):\n"
+        "    return jnp.dot(x.astype(jnp.float32), w)\n"
+        "def unknown_dtypes(x, w):\n"
+        "    return jnp.dot(x, w)\n"
+    )
+    assert findings_for(p, "implicit-upcast") == []
+
+
+def test_implicit_upcast_scoping_and_noqa(tmp_path):
+    """Out of the hot-path dirs (ops/models/parallel/precision) the rule is
+    silent; in scope, # noqa documents a deliberate inference."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def mix(x, w):\n"
+        "    return jnp.dot(x.astype(jnp.bfloat16), w)\n"
+    )
+    cold = tmp_path / "analysis.py"
+    cold.write_text(src)
+    assert findings_for(cold, "implicit-upcast") == []
+    d = tmp_path / "models"
+    d.mkdir()
+    hot = d / "net.py"
+    hot.write_text(src.replace(", w)", ", w)  # noqa: implicit-upcast"))
+    assert findings_for(hot, "implicit-upcast") == []
+
+
+def test_implicit_upcast_repo_hot_paths_clean():
+    """The shipped mixed-precision code states its accumulation dtype: the
+    rule's own scope stays 0-findings (the baseline stays empty)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        ImplicitUpcastRule,
+    )
+
+    rule = ImplicitUpcastRule()
+    assert rule.applies(
+        Path("cuda_mpi_gpu_cluster_programming_tpu/precision/quantize.py")
+    )
+    assert not rule.applies(Path("cuda_mpi_gpu_cluster_programming_tpu/run.py"))
+    pkg = ROOT / "cuda_mpi_gpu_cluster_programming_tpu"
+    files = [
+        f
+        for sub in ("ops", "models", "parallel", "precision")
+        for f in sorted((pkg / sub).glob("*.py"))
+    ]
+    assert files
+    assert [f for f in files if findings_for(f, "implicit-upcast")] == []
+
+
 # ---------------------------------------------------------------------------
 # engine features
 
@@ -569,7 +650,7 @@ def test_cli_list_rules_has_all_new_codes():
     assert proc.returncode == 0
     for code in (
         "collective-axis", "unreduced-contraction", "host-sync-in-hot-loop",
-        "key-reuse", "jit-in-loop", "check-vma-disabled",
+        "key-reuse", "jit-in-loop", "check-vma-disabled", "implicit-upcast",
         "raw-subprocess", "atomic-write", "variant-env", "deprecated",
     ):
         assert code in proc.stdout, code
