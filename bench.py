@@ -437,7 +437,7 @@ def _serve_drill(model_cfg) -> dict:
         a.status == OK and b.status == OK and np.array_equal(a.result, b.result)
         for a, b in zip(handles, clean_handles)
     )
-    return {
+    drill = {
         "config": scfg.config,
         "shards": scfg.n_shards,
         "n_requests": n_req,
@@ -448,6 +448,40 @@ def _serve_drill(model_cfg) -> dict:
         "replayed_in_flight": bool(sup.trips),
         "bit_identical": bit_identical,
     }
+    # Mesh-shrink drill: ACTUALLY drop devices mid-load (seeded) and prove
+    # the true-elastic path — rebuild over the surviving-device mesh, live
+    # param reshard, bucket re-warm — finishes every request with zero
+    # post-rewarm cache misses. The row is machine-comparable across
+    # BENCH_r* rounds (devices_before/after, rewarm_ms, replayed).
+    try:
+        os.environ[chaos.CHAOS_ENV] = os.environ.get(
+            "BENCH_SERVE_SHRINK_CHAOS", "seed=3,mesh_shrink=1"
+        )
+        chaos.reset()
+        try:
+            shrunk = InferenceServer(scfg)
+            sh_handles = _drain(shrunk)
+        finally:
+            if saved is None:
+                os.environ.pop(chaos.CHAOS_ENV, None)
+            else:
+                os.environ[chaos.CHAOS_ENV] = saved
+            chaos.reset()
+        ssup = shrunk.sup
+        drill["mesh_shrink"] = {
+            "n_requests": n_req,
+            "completed": sum(1 for h in sh_handles if h.status == OK),
+            "devices_before": ssup.pool.n_total,
+            "devices_after": ssup.pool.n_alive,
+            "rewarm_ms": round(shrunk.stats.rewarm_ms, 3),
+            "replayed": ssup.replays,
+            "trips": [t.kind for t in ssup.trips],
+            "final_entry": ssup.entry.key,
+            "cache_misses_post_rewarm": shrunk.stats.cache_misses,
+        }
+    except Exception as e:  # evidence, not the headline — degrade visibly
+        drill["mesh_shrink"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return drill
 
 
 def _plan_policy_for(model_cfg) -> str:
@@ -478,7 +512,9 @@ def _serve_main() -> int:
     BENCH_SERVE_SUPERVISE (1), BENCH_SERVE_JOURNAL (tempdir),
     BENCH_SERVE_HEIGHT/WIDTH (227 — CI smokes shrink the geometry),
     BENCH_SERVE_DRILL (1), BENCH_SERVE_DRILL_CONFIG (v2.2_sharded),
-    BENCH_SERVE_DRILL_SHARDS (2). Always exactly one JSON line, exit 0.
+    BENCH_SERVE_DRILL_SHARDS (2), BENCH_SERVE_SHRINK_CHAOS
+    (seed=3,mesh_shrink=1 — the drill sub-object's mesh_shrink row).
+    Always exactly one JSON line, exit 0.
     """
     import tempfile
 

@@ -1,0 +1,170 @@
+"""True elastic meshes: surviving-device pools + live resharding.
+
+PR 5's supervisor answers a device loss by re-planning down its ladder,
+but every rebuilt rung still constructs its Mesh from the FULL device pool
+(``make_mesh`` slices ``jax.devices()[:n]``) — re-planning on the same
+device set that just lost a member. The reference's V4 hybrid has the same
+gap one layer down: an MPI rank death kills the whole row-scatter job
+(v4_mpi_cuda/src/main_mpi_cuda.cpp — no communicator shrink, no respawn).
+This module makes the shrink real:
+
+- :class:`ElasticPool` tracks which devices are lost and **re-queries**
+  ``jax.devices()`` at every mesh build — never a module-cached list
+  (staticcheck's ``stale-device-set`` rule pins exactly this discipline:
+  a device list cached at import time keeps naming the dead chip inside
+  every later rebuild).
+- :meth:`ElasticPool.mesh_for` builds shard_map-compatible meshes over the
+  SURVIVORS, so a degrade rung's collectives never route through a lost
+  device.
+- :func:`reshard_tree` / :func:`reshard_train_state` move live params /
+  optimizer state onto the new mesh via ``jax.device_put`` with the new
+  sharding — a degrade re-homes state directly instead of round-tripping
+  through a checkpoint (the checkpoint stays the floor, not the fast
+  path; see utils/checkpoint.py reshard-on-load for the restore side).
+
+Every shrink is journaled (``mesh_shrink`` records) and drillable on CPU:
+``CHAOS_SPEC="seed=3,mesh_shrink=k"`` drops k seeded devices mid-run
+(docs/RESILIENCE.md "True elastic meshes").
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, List, Optional, Set, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+
+PyTree = object
+
+
+class ElasticPool:
+    """The surviving-device set, queried fresh at every mesh build.
+
+    ``alive()`` filters the CURRENT ``jax.devices()`` against the lost-id
+    set rather than caching a device list — the pool owns the *exclusions*,
+    the runtime owns the *roster*, so a rebuild after any runtime-side
+    change (a healed tunnel re-enumerating, a restarted backend) sees the
+    truth of that moment.
+    """
+
+    def __init__(self, journal=None, site: str = "elastic"):
+        self.journal = journal
+        self.site = site
+        self._lost_ids: Set[int] = set()
+        self.shrinks: List[dict] = []  # one record per lose() call
+
+    # ------------------------------------------------------------ queries
+
+    def alive(self) -> List[jax.Device]:
+        """Surviving devices, re-queried from the runtime NOW."""
+        return [d for d in jax.devices() if d.id not in self._lost_ids]
+
+    @property
+    def n_total(self) -> int:
+        return len(jax.devices())
+
+    @property
+    def n_alive(self) -> int:
+        return len(self.alive())
+
+    @property
+    def n_lost(self) -> int:
+        return len(self._lost_ids)
+
+    def summary(self) -> str:
+        return f"{self.n_alive}/{self.n_total}"
+
+    # ------------------------------------------------------------- shrink
+
+    def lose(self, devices: Iterable, cause: str = "device_loss") -> dict:
+        """Mark devices (``jax.Device``s or integer ids) as lost.
+
+        Refuses to lose the LAST device — the single-device reference floor
+        must keep somewhere to land (a fleet with zero survivors has no
+        recovery story; that is a page, not a degrade). Journals a
+        ``mesh_shrink`` record naming before/after/lost so the incident
+        trail shows the topology change next to the supervisor's trips.
+        """
+        ids = {d if isinstance(d, int) else d.id for d in devices}
+        survivors = [d for d in self.alive() if d.id not in ids]
+        if not survivors:
+            raise ValueError(
+                f"refusing to lose all {self.n_alive} surviving devices "
+                f"(ids {sorted(ids)}): the single-device floor needs one"
+            )
+        before = self.n_alive
+        self._lost_ids |= ids
+        record = {
+            "before": before,
+            "after": self.n_alive,
+            "lost": sorted(ids),
+            "cause": cause,
+        }
+        self.shrinks.append(record)
+        if self.journal is not None:
+            self.journal.append(
+                "mesh_shrink",
+                key=f"shrink:{before}->{self.n_alive}",
+                site=self.site,
+                **record,
+            )
+        return record
+
+    # -------------------------------------------------------------- build
+
+    def mesh_for(self, n_shards: int, axis_name: str = "sp", dp: int = 1) -> Mesh:
+        """A mesh over the first ``dp * n_shards`` SURVIVORS.
+
+        Raises the standard ``mesh needs N devices, have M`` ValueError
+        when the pool has shrunk below the request — the supervisor's
+        eager-build degrade loop treats that as "rung unsatisfiable" and
+        keeps walking the ladder.
+        """
+        return make_mesh(
+            max(1, int(n_shards)), axis_name=axis_name, dp=dp, devices=self.alive()
+        )
+
+
+def seeded_victims(pool: ElasticPool, k: int, seed) -> List[jax.Device]:
+    """k seeded victims among the pool's survivors — never the lowest-id
+    survivor, which the single-device floor (and the chaos drill's clean
+    comparison run) lands on. Deterministic per (seed, surviving set)."""
+    alive = pool.alive()
+    k = max(0, min(int(k), len(alive) - 1))
+    if k == 0:
+        return []
+    rng = random.Random(f"{seed}:mesh_shrink")
+    return rng.sample(alive[1:], k)
+
+
+def reshard_tree(tree: PyTree, mesh: Mesh, spec: Optional[P] = None) -> PyTree:
+    """``jax.device_put`` a live pytree onto ``mesh`` under ``spec``
+    (default ``P()`` — fully replicated, the framework's params-replicated
+    discipline for the sp/tp training and serving paths). Values are
+    untouched; only placement changes — buffers on a lost device are
+    re-materialized from a surviving replica."""
+    return jax.device_put(tree, NamedSharding(mesh, spec if spec is not None else P()))
+
+
+def reshard_train_state(
+    params: PyTree, opt_state: PyTree, mesh: Mesh, spec: Optional[P] = None
+) -> Tuple[PyTree, PyTree]:
+    """Reshard live (params, opt_state) onto ``mesh`` in one call — the
+    supervisor's step-replay path re-homes BOTH before re-running a batch,
+    so the optimizer update never mixes placements."""
+    placed = reshard_tree((params, opt_state), mesh, spec)
+    return placed[0], placed[1]
+
+
+def tree_device_ids(tree: PyTree) -> Set[int]:
+    """All device ids any leaf of ``tree`` currently lives on (test /
+    assertion surface for the reshard contract)."""
+    ids: Set[int] = set()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        devs = getattr(leaf, "devices", None)
+        if callable(devs):
+            ids |= {d.id for d in devs()}
+    return ids

@@ -28,6 +28,14 @@ fires would report "recovery path exercised" without exercising anything):
                       before screening, so the StageDigests checker must
                       trip stage_digest and the supervisor must degrade,
                       replay the batch, and match the uninjected oracle.
+    mesh_shrink       resilience.supervisor / parallel.elastic — drop k
+                      seeded devices from the elastic pool mid-run. The
+                      count is a MAGNITUDE consumed as one event
+                      (``mesh_shrink=2`` = one shrink losing 2 devices,
+                      via ``ChaosInjector.drain``); ``mesh_shrink=pX``
+                      drops 1 device per fired draw. The supervisor must
+                      rebuild Mesh/shard_map closures over the survivors,
+                      reshard live state, and replay the failed batch/step.
     kernel_compile    run CLI build step (pallas tier) — Mosaic lowering
                       failure; degrades Pallas -> XLA reference tier.
     subprocess_wedge  harness.run_case — the classic wedged-tunnel capture
@@ -66,6 +74,7 @@ KNOWN_SITES = (
     "sdc",
     "nan_loss",
     "stage_sdc",
+    "mesh_shrink",
 )
 
 
@@ -141,6 +150,17 @@ class ChaosInjector:
         if hit:
             self.fired[site] = self.fired.get(site, 0) + 1
         return hit
+
+    def drain(self, site: str) -> int:
+        """Consume and return ALL remaining count-based hits at ``site``
+        (0 when none). For sites where the spec's count is a magnitude one
+        event carries (``mesh_shrink=k`` drops k devices in ONE shrink)
+        rather than N separate transient faults. Probabilistic sites are
+        untouched — their per-draw stream still fires via ``draw``."""
+        n = self._remaining.pop(site, 0)
+        if n > 0:
+            self.fired[site] = self.fired.get(site, 0) + n
+        return n
 
     def maybe_raise(self, site: str, detail: str = "") -> None:
         if self.draw(site):

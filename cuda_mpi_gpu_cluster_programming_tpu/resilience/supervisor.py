@@ -25,14 +25,30 @@ training loop. Neither sees a bit flip, a diverged replica, or a lost chip
   PR 2 tuning plan keeps its env > plan > default precedence on the way
   down the ladder.
 
+Since PR 8 the re-plan is a TRUE elastic rebuild (parallel.elastic): the
+supervisor owns an :class:`~..parallel.elastic.ElasticPool`, every sharded
+rung's Mesh/shard_map closures are built over the pool's SURVIVING device
+set (re-queried at build time, never a cached list — staticcheck's
+``stale-device-set`` rule), a ``mesh_shrink``/``device_loss`` trip
+reshards live params (and, on the training path, optimizer state) onto
+the new mesh via ``jax.device_put`` before the replay, and
+:meth:`Supervisor.supervise_step` extends the same trip→re-plan→replay
+contract from forwards to TRAINING steps — step-level replay of the same
+batch (journaled ``sup_step``/``sup_replay``) instead of whole-checkpoint
+rollback, with the checkpoint rollback remaining the floor
+(train.py ``--supervise-steps`` / ``--max-rollbacks``).
+
 Every recovery path is drillable on CPU: ``CHAOS_SPEC="stage_sdc=1"``
 corrupts a seeded stage digest before screening, ``device_loss=1`` raises
-the mesh-shrink signature before the forward runs (docs/RESILIENCE.md).
+the mesh-shrink signature before the forward runs, and ``mesh_shrink=k``
+actually drops k seeded devices from the pool so the rebuild lands on a
+genuinely smaller mesh (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import random
 import time
 from typing import Callable, Dict, List, Optional
@@ -53,7 +69,13 @@ from .sentinel import (
 # Mesh-shrink signatures a real device loss surfaces as (jax raises plain
 # RuntimeError/ValueError quoting device counts; chaos mimics the same
 # message so triage sees one grammar).
-_DEVICE_LOSS_MARKERS = ("device_loss", "devices, have", "), have ")
+_DEVICE_LOSS_MARKERS = ("device_loss", "mesh_shrink", "devices, have", "), have ")
+
+
+def _loss_kind(e: BaseException) -> str:
+    """Which SDC kind a classified device-loss exception carries: an
+    actual pool shrink vs. a transient single-device loss signature."""
+    return "mesh_shrink" if "mesh_shrink" in str(e) else "device_loss"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,6 +118,24 @@ def default_ladder(strategy: str, tier: str, n_shards: int) -> List[LadderEntry]
     return entries
 
 
+def train_ladder(sp_shards: int = 0, tp_shards: int = 0) -> List[LadderEntry]:
+    """The TRAINING-step ladder: the requested sharded strategy at halved
+    shard counts down to 2, then the single-device reference floor.
+    ``replicated`` is an inference-only rung (every device redundantly
+    running the same optimizer step buys no divergence screen the sentinel
+    doesn't already provide, at N× the FLOPs), so training skips it."""
+    if sp_shards and tp_shards:
+        raise ValueError("sp_shards and tp_shards are mutually exclusive strategies")
+    strategy = "halo" if sp_shards else ("tp" if tp_shards else "single")
+    entries: List[LadderEntry] = []
+    n = sp_shards or tp_shards or 1
+    while n >= 2:
+        entries.append(LadderEntry(strategy, "reference", n))
+        n //= 2
+    entries.append(LadderEntry("single", "reference", 1))
+    return entries
+
+
 def _is_device_loss(e: BaseException) -> bool:
     msg = str(e)
     return isinstance(e, (RuntimeError, ValueError, chaos.InjectedFault)) and any(
@@ -120,6 +160,8 @@ class Supervisor:
         journal: Optional[Journal] = None,
         on_event: Optional[Callable[[DegradedEvent], None]] = None,
         on_rebuild: Optional[Callable[[LadderEntry], None]] = None,
+        pool=None,
+        step_builder: Optional[Callable] = None,
         site: str = "supervisor",
     ):
         if not ladder:
@@ -134,14 +176,30 @@ class Supervisor:
         # buckets here so even the replay hits a compiled shape and the
         # zero-cache-miss dispatch discipline survives degradation.
         self.on_rebuild = on_rebuild
+        if pool is None:
+            from ..parallel.elastic import ElasticPool
+
+            pool = ElasticPool(journal=journal, site=site)
+        # The surviving-device set every sharded rung builds its mesh over;
+        # a mesh_shrink trip loses devices here, and an unsatisfiable rung
+        # (needs more devices than survive) fails its eager build and is
+        # skipped by the degrade loop.
+        self.pool = pool
+        # ``step_builder(entry, mesh) -> step_fn`` puts the supervisor in
+        # TRAINING mode (supervise_step): step_fn has the make_train_step
+        # contract (params, opt_state, x, y) -> (params', opt_state',
+        # loss[, grad_norm]). See training.make_elastic_step_builder.
+        self.step_builder = step_builder
         self.site = site
         self.checker = StageDigests(sentinel_cfg, site=site)
         self.trips: List[SDC] = []
         self.events: List[DegradedEvent] = []
         self.attempts = 0
+        self.replays = 0  # batches/steps re-run on a new rung after a trip
         self.compile_ms: Optional[float] = None
         self._idx = 0
         self._fwd: Optional[Callable] = None
+        self._sfn: Optional[Callable] = None
         self._step = 0
 
     # ------------------------------------------------------------ building
@@ -154,6 +212,15 @@ class Supervisor:
         if self.journal is not None:
             self.journal.append(kind, key=key, **payload)
 
+    def _entry_mesh(self, entry: LadderEntry):
+        """The surviving-device mesh this rung runs on (None for the
+        single floor) — built through the pool so a post-shrink rebuild
+        can never route a collective through a lost device."""
+        if entry.strategy == "single" or entry.n_shards < 2:
+            return None
+        axis = "tp" if entry.strategy == "tp" else "sp"
+        return self.pool.mesh_for(entry.n_shards, axis_name=axis)
+
     def _build_entry(self, entry: LadderEntry) -> Callable:
         cfg = self.model_cfg
         if entry.strategy in ("halo", "staged_halo"):
@@ -165,6 +232,7 @@ class Supervisor:
             return build_sharded_forward(
                 cfg,
                 entry.n_shards,
+                mesh=self._entry_mesh(entry),
                 tier=entry.tier,
                 staged=(entry.strategy == "staged_halo"),
                 with_digests=True,
@@ -173,11 +241,17 @@ class Supervisor:
         if entry.strategy == "tp":
             from ..parallel.tensor_parallel import build_tp_forward
 
-            return build_tp_forward(cfg, entry.n_shards, with_digests=True)
+            return build_tp_forward(
+                cfg, entry.n_shards, mesh=self._entry_mesh(entry), with_digests=True
+            )
         if entry.strategy == "replicated":
             from ..parallel.replicated import build_replicated_forward
 
-            return self._wrap_digest(build_replicated_forward(cfg, entry.n_shards))
+            return self._wrap_digest(
+                build_replicated_forward(
+                    cfg, entry.n_shards, mesh=self._entry_mesh(entry)
+                )
+            )
         if entry.strategy == "single":
             # Through configs.build_forward so a PR 2 TunePlan keeps its
             # env > plan > default variant precedence on the pallas floor.
@@ -211,6 +285,51 @@ class Supervisor:
             self._fwd = self._build_entry(self.entry)
             self._journal("sup_build", key=self.entry.key, entry=self.entry.key)
         return self._fwd
+
+    def step_fn(self) -> Callable:
+        """The current rung's TRAINING step (training mode only): built by
+        ``step_builder(entry, mesh)`` against the surviving-device mesh,
+        lazily, journaled like the forward builds."""
+        if self.step_builder is None:
+            raise ValueError(
+                "supervise_step needs Supervisor(step_builder=...) — see "
+                "training.make_elastic_step_builder"
+            )
+        if self._sfn is None:
+            entry = self.entry
+            self._sfn = self.step_builder(entry, self._entry_mesh(entry))
+            self._journal("sup_build", key=f"step:{entry.key}", entry=entry.key)
+        return self._sfn
+
+    def _build_current(self) -> None:
+        """Eagerly build the current rung's executable — the step in
+        training mode, the forward otherwise (the degrade loop uses this
+        to prove a rung buildable before landing on it)."""
+        if self.step_builder is not None:
+            self.step_fn()
+        else:
+            self.fwd()
+
+    @off_timed_path
+    def reshard(self, tree):
+        """Live-reshard a pytree onto the CURRENT rung's surviving-device
+        mesh (``jax.device_put`` under the replicated ``P()`` layout; the
+        single floor gets a 1-device mesh over the first survivor). The
+        degrade path calls this on params/opt-state so a replay never
+        touches buffers homed on a lost device — and never round-trips
+        through a checkpoint."""
+        from ..parallel.elastic import reshard_tree
+
+        entry = self.entry
+        n = entry.n_shards if entry.strategy != "single" else 1
+        mesh = self.pool.mesh_for(max(1, n))
+        self._journal(
+            "sup_reshard",
+            key=f"reshard:{entry.key}:{self.pool.summary()}",
+            entry=entry.key,
+            devices=self.pool.n_alive,
+        )
+        return reshard_tree(tree, mesh)
 
     @off_timed_path
     def warm(self, params, x) -> float:
@@ -248,6 +367,33 @@ class Supervisor:
                 f"entry {entry.key} needs {entry.n_shards} devices, have "
                 f"{entry.n_shards - 1}",
             )
+
+    def _maybe_chaos_mesh_shrink(self, entry: LadderEntry) -> None:
+        """The ``mesh_shrink=k`` drill: ACTUALLY lose k seeded devices from
+        the pool (one event carrying the whole count — chaos.drain), then
+        raise the device-loss signature so the trip path rebuilds over the
+        survivors. Unlike ``device_loss`` this is not transient: every
+        later mesh build sees the smaller pool."""
+        ch = chaos.active()
+        if ch is None or entry.n_shards <= 1 or self.pool.n_alive <= 1:
+            return
+        k = ch.drain("mesh_shrink")
+        if k == 0 and ch.draw("mesh_shrink"):
+            k = 1
+        if k == 0:
+            return
+        from ..parallel.elastic import seeded_victims
+
+        victims = seeded_victims(self.pool, k, ch.spec.seed)
+        if not victims:
+            return
+        self.pool.lose(victims, cause="chaos:mesh_shrink")
+        raise chaos.InjectedFault(
+            "mesh_shrink",
+            f"lost {len(victims)} device(s) {sorted(d.id for d in victims)}; "
+            f"entry {entry.key} mesh is stale — {self.pool.n_alive} of "
+            f"{self.pool.n_total} devices survive",
+        )
 
     def _maybe_chaos_stage_sdc(self, digests: Dict) -> Dict:
         ch = chaos.active()
@@ -306,8 +452,12 @@ class Supervisor:
             )
             self._idx += 1
             self._fwd = None
+            self._sfn = None
             try:
-                self.fwd()  # build eagerly: an unbuildable rung degrades again
+                # Build eagerly: an unbuildable rung degrades again — which
+                # now includes "needs more devices than survive the shrink"
+                # (pool.mesh_for raises the mesh-needs-N ValueError).
+                self._build_current()
                 if self.on_rebuild is not None:
                     self.on_rebuild(self.entry)
                 return
@@ -339,6 +489,7 @@ class Supervisor:
                 self._advance(f"build failed: {type(e).__name__}: {e}"[:200], e)
                 continue
             try:
+                self._maybe_chaos_mesh_shrink(entry)
                 self._maybe_chaos_device_loss(entry)
                 t0 = time.perf_counter()
                 out, digests = fwd(params, x)
@@ -357,21 +508,24 @@ class Supervisor:
                     cause=str(e)[:200],
                 )
                 self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
+                params = self._replay_state(params)
                 continue
             except Exception as e:  # noqa — classified below
                 if not _is_device_loss(e):
                     raise
-                sdc = SDC("device_loss", self._step, str(e)[:200])
+                kind = _loss_kind(e)
+                sdc = SDC(kind, self._step, str(e)[:200])
                 self.trips.append(sdc)
                 self._journal(
                     "sup_trip",
                     key=f"trip:{len(self.trips)}",
-                    sdc_kind="device_loss",
+                    sdc_kind=kind,
                     step=self._step,
                     entry=entry.key,
                     cause=str(e)[:200],
                 )
-                self._advance(f"SDC(device_loss): {e}"[:200], sdc)
+                self._advance(f"SDC({kind}): {e}"[:200], sdc)
+                params = self._replay_state(params)
                 continue
             self._journal(
                 "sup_ok",
@@ -381,6 +535,129 @@ class Supervisor:
             )
             self._step += 1
             return out
+
+    @off_timed_path
+    def _replay_state(self, tree):
+        """Post-degrade, pre-replay bookkeeping: live-reshard the state
+        onto the landed rung's surviving-device mesh and journal the
+        replay — the record that distinguishes step-level recovery from a
+        checkpoint rollback in the incident trail."""
+        self.replays += 1
+        tree = self.reshard(tree)
+        self._journal(
+            "sup_replay",
+            key=f"replay:{self.replays}",
+            step=self._step,
+            entry=self.entry.key,
+        )
+        return tree
+
+    @off_timed_path
+    def supervise_step(self, params, opt_state, x, y, step: Optional[int] = None):
+        """Run ONE training step under supervision; returns the step_fn
+        output tuple ``(new_params, new_opt_state, loss[, grad_norm])``
+        from SOME rung.
+
+        The training twin of :meth:`execute`: a device loss / mesh shrink
+        mid-step, or a non-finite loss/grad-norm, trips → the supervisor
+        re-plans down the ladder (skipping rungs the surviving pool cannot
+        satisfy), **reshards live params AND optimizer state** onto the
+        new mesh, and REPLAYS the same ``(x, y)`` batch — step-level
+        recovery, no checkpoint consumed. ``sup_step`` journals each
+        committed step; ``sup_replay`` each replay. Raises
+        :class:`DegradationExhausted` when the ladder is spent (the
+        caller's checkpoint rollback is the floor below this)."""
+        import jax
+
+        if self.step_builder is None:
+            raise ValueError(
+                "supervise_step needs Supervisor(step_builder=...) — see "
+                "training.make_elastic_step_builder"
+            )
+        if step is not None:
+            self._step = step
+        while True:
+            self.attempts += 1
+            entry = self.entry
+            try:
+                fn = self.step_fn()
+            except Exception as e:  # noqa — unbuildable rung: degrade
+                self._advance(f"build failed: {type(e).__name__}: {e}"[:200], e)
+                params, opt_state = self._replay_state((params, opt_state))
+                continue
+            try:
+                self._maybe_chaos_mesh_shrink(entry)
+                self._maybe_chaos_device_loss(entry)
+                out = fn(params, opt_state, x, y)
+                jax.block_until_ready(out[2])
+                loss = float(out[2])
+                gnorm = float(out[3]) if len(out) > 3 else None
+                for name, v in (("loss", loss), ("grad_norm", gnorm)):
+                    if v is not None and not math.isfinite(v):
+                        raise SDC(
+                            "step_nonfinite",
+                            self._step,
+                            f"{self.site}/{entry.key}: {name} = {v}",
+                        )
+            except SDC as e:
+                self.trips.append(e)
+                self._journal(
+                    "sup_trip",
+                    key=f"trip:{len(self.trips)}",
+                    sdc_kind=e.kind,
+                    step=e.step,
+                    entry=entry.key,
+                    cause=str(e)[:200],
+                )
+                self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
+                params, opt_state = self._replay_state((params, opt_state))
+                continue
+            except Exception as e:  # noqa — classified below
+                if not _is_device_loss(e):
+                    raise
+                kind = _loss_kind(e)
+                sdc = SDC(kind, self._step, str(e)[:200])
+                self.trips.append(sdc)
+                self._journal(
+                    "sup_trip",
+                    key=f"trip:{len(self.trips)}",
+                    sdc_kind=kind,
+                    step=self._step,
+                    entry=entry.key,
+                    cause=str(e)[:200],
+                )
+                self._advance(f"SDC({kind}): {e}"[:200], sdc)
+                params, opt_state = self._replay_state((params, opt_state))
+                continue
+            self._journal(
+                "sup_step",
+                key=f"sstep:{self._step}",
+                entry=entry.key,
+                attempts=self.attempts,
+                replays=self.replays,
+            )
+            self._step += 1
+            return out
+
+    def trip_external(self, e: SDC, params, opt_state):
+        """An out-of-band trip from the caller's host-side screening (the
+        train loop's Sentinel: norm spikes, param bit-flips, injected
+        nan_loss) routed into the same degrade→reshard→replay path a
+        supervised step takes. Returns the resharded ``(params,
+        opt_state)`` the caller replays the batch with; raises
+        :class:`DegradationExhausted` when the ladder is spent — at which
+        point checkpoint rollback remains the floor."""
+        self.trips.append(e)
+        self._journal(
+            "sup_trip",
+            key=f"trip:{len(self.trips)}",
+            sdc_kind=e.kind,
+            step=e.step,
+            entry=self.entry.key,
+            cause=str(e)[:200],
+        )
+        self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
+        return self._replay_state((params, opt_state))
 
     # ------------------------------------------------------------ surfacing
 
@@ -395,5 +672,6 @@ class Supervisor:
         return (
             f"attempts={self.attempts} trips={len(self.trips)} "
             f"degradations={len(self.events)} entry={self.entry.key} "
-            f"kinds={kinds}"
+            f"kinds={kinds} replays={self.replays} "
+            f"pool={self.pool.summary()}"
         )

@@ -80,6 +80,7 @@ class ServeStats:
     n_failed: int = 0
     warmup_compiles: int = 0
     cache_misses: int = 0  # post-warmup dispatches at an un-warmed shape
+    rewarm_ms: float = 0.0  # wall ms spent re-compiling buckets on degrades
     batch_ms: List[float] = dataclasses.field(default_factory=list)
 
     def summary(self) -> str:
@@ -215,15 +216,23 @@ class InferenceServer:
         """Supervisor on_rebuild hook: a degrade landed on a fresh rung, so
         every bucket must compile again BEFORE the failed batch replays —
         re-warming here keeps the replay itself a cache hit and the
-        steady-state miss count at zero across degradations."""
+        steady-state miss count at zero across degradations. The params are
+        live-resharded onto the rung's surviving-device mesh FIRST, so the
+        warm compiles land on exactly the placement the replay (which the
+        supervisor reshards the same way) will dispatch with — after a
+        mesh shrink nothing here touches a lost device."""
         self._warmed.clear()
+        self._params = self.sup.reshard(self._params)
+        ms = 0.0
         for bucket in self.buckets:
-            self.sup.warm(self._params, self._warm_input(bucket))
+            ms += self.sup.warm(self._params, self._warm_input(bucket))
             self.stats.warmup_compiles += 1
             self._warmed.add(bucket)
+        self.stats.rewarm_ms += ms
         self._journal(
             "serve_rewarm", key=f"rewarm:{entry.key}", entry=entry.key,
-            buckets=list(self.buckets),
+            buckets=list(self.buckets), ms=round(ms, 3),
+            devices=self.sup.pool.n_alive,
         )
 
     # ------------------------------------------------------------ lifecycle

@@ -41,6 +41,16 @@ gate that cries wolf gets ``# noqa``'d into uselessness.
                          whole body (ops.vma exists so kernels can keep it
                          ON; a deliberate disable documents itself with
                          ``# noqa: check-vma-disabled <reason>``).
+  stale-device-set     — a Mesh/make_mesh/mesh_for call inside a function
+                         consuming a MODULE-cached ``jax.devices()`` /
+                         ``jax.local_devices()`` list. By the time a
+                         rebuild/retry path runs, the device set may have
+                         shrunk — the cached list still names the lost
+                         chip, so every "recovered" mesh routes
+                         collectives through a dead device. Re-query at
+                         build time (``parallel.elastic.ElasticPool``
+                         owns this discipline). Module-scope mesh builds
+                         (executed at import, list is fresh) are exempt.
   implicit-upcast      — a dot/conv contraction primitive in a hot-path
                          module (ops/, models/, parallel/, precision/)
                          fed a bf16/int8-cast operand with no explicit
@@ -741,6 +751,87 @@ class ImplicitUpcastRule(Rule):
                 )
             )
         return findings
+
+
+# ---------------------------------------------------------------------------
+# stale-device-set
+
+
+_DEVICE_QUERIES = {"devices", "local_devices"}
+_MESH_BUILDERS = {"Mesh", "make_mesh", "mesh_for"}
+
+
+def _is_device_query(node: ast.expr) -> bool:
+    """``jax.devices()``/``jax.local_devices()``, optionally wrapped in a
+    list()/tuple()/sorted() materializer."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in ("list", "tuple", "sorted") and node.args:
+        return _is_device_query(node.args[0])
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in _DEVICE_QUERIES
+        and _root_name(f) == "jax"
+    )
+
+
+@register
+class StaleDeviceSetRule(Rule):
+    code = "stale-device-set"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        # Module-scope names bound to a device query at import time — the
+        # cache whose staleness the rule is about. Anything queried inside
+        # the consuming function is by definition fresh and never flagged.
+        cached: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_device_query(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        cached.add(t.id)
+        if not cached:
+            return []
+        fn_spans = [
+            (f.lineno, getattr(f, "end_lineno", f.lineno))
+            for f in ast.walk(ctx.tree)
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_attr(node.func) not in _MESH_BUILDERS:
+                continue
+            # Module-scope builds run at import with the list still fresh;
+            # only deferred (in-function — i.e. rebuild/retry-path) builds
+            # can consume a stale cache.
+            if not any(a <= node.lineno <= b for a, b in fn_spans):
+                continue
+            used = sorted(
+                name
+                for name in cached
+                if any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]
+                    for sub in ast.walk(arg)
+                )
+            )
+            if used:
+                out.append(
+                    self.finding(
+                        ctx, node.lineno,
+                        f"mesh built from {'/'.join(used)!r}, a module-cached "
+                        "jax.devices() list, inside a function: by "
+                        "rebuild/retry time the device set may have shrunk "
+                        "and the mesh would still name the lost device — "
+                        "re-query jax.devices() at build time (or route "
+                        "through parallel.elastic.ElasticPool.alive()); "
+                        "deliberate pins: # noqa: stale-device-set",
+                        span=(node.lineno, getattr(node, "end_lineno", node.lineno)),
+                    )
+                )
+        return out
 
 
 # ---------------------------------------------------------------------------

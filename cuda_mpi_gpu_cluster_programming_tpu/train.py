@@ -24,6 +24,12 @@ uninterrupted run would have seen:
 
     python -m cuda_mpi_gpu_cluster_programming_tpu.train --steps 200 \\
         --checkpoint-every 20 --work-dir logs/train_work
+
+``--supervise-steps`` additionally puts every step under the elastic
+supervisor (docs/RESILIENCE.md "True elastic meshes"): a mid-step device/
+mesh loss or sentinel trip degrades down the shard ladder, rebuilds the
+step over the SURVIVING devices, live-reshards params+opt-state, and
+replays the same batch — rollback only once the ladder is spent.
 """
 
 from __future__ import annotations
@@ -81,6 +87,19 @@ def make_parser() -> argparse.ArgumentParser:
         "generation loadable (0 = single-file npz, the historical format)",
     )
     p.add_argument(
+        "--supervise-steps",
+        action="store_true",
+        help="supervisor-managed training steps (requires --checkpoint-every; "
+        "docs/RESILIENCE.md 'True elastic meshes'): a sentinel trip or a "
+        "device/mesh loss DURING a step degrades down the elastic ladder "
+        "(halo@n -> halo@n/2 -> ... -> single@1), rebuilds the step over "
+        "the surviving-device mesh, live-reshards params+opt-state onto it "
+        "(jax.device_put, no checkpoint round-trip) and replays the SAME "
+        "batch — step-level replay instead of whole-checkpoint rollback; "
+        "rollback remains the floor once the ladder or --max-rollbacks is "
+        "exhausted. Prints one machine-parseable 'Elastic: ...' line",
+    )
+    p.add_argument(
         "--max-rollbacks",
         type=int,
         default=2,
@@ -118,7 +137,7 @@ def make_parser() -> argparse.ArgumentParser:
 
 def _run_resilient_loop(
     args, jr, save_state, load_state, start_step, get_batch, teacher_fwd, teacher,
-    step_fn, student, opt_state, sentinel, mesh, flog,
+    step_fn, student, opt_state, sentinel, mesh, flog, sup=None,
 ):
     """The quarantine-capable training loop (``--checkpoint-every`` > 0).
 
@@ -131,10 +150,19 @@ def _run_resilient_loop(
     ``--max-rollbacks`` consecutive trips without a successful checkpoint
     abort with rc 3. Returns either an exit code (int) or
     ``(first_loss, last_loss, steps_run)``.
+
+    With ``sup`` (``--supervise-steps``, an elastic
+    :class:`~.resilience.supervisor.Supervisor` in step mode) the CHEAP
+    recovery comes first: any trip — in-step device/mesh loss caught by
+    ``supervise_step``, or a host-side sentinel trip routed through
+    ``trip_external`` — degrades the ladder, live-reshards the state onto
+    the surviving-device mesh, and REPLAYS the same step-indexed batch.
+    Checkpoint rollback runs only once the ladder is exhausted.
     """
     import jax
 
     from .resilience import chaos
+    from .resilience.policy import DegradationExhausted
     from .resilience.sentinel import SDC
 
     first = last = None
@@ -142,10 +170,45 @@ def _run_resilient_loop(
     rollbacks = 0
     steps_run = 0
     i = start_step
+
+    def _rollback(cause: str):
+        """The floor: consume one rollback, restore last-good, rewind.
+        Returns rc 3 when the budget is spent, else None."""
+        nonlocal rollbacks, student, opt_state, i
+        rollbacks += 1
+        flog.record("retry", cause=cause[:160])
+        jr.append("rollback", key=f"rollback:{i + 1}", step=i + 1, cause=cause[:200])
+        print(
+            f"{cause} -> rollback to last-good step {last_good_step} "
+            f"(rollback {rollbacks}/{args.max_rollbacks})",
+            flush=True,
+        )
+        if rollbacks > args.max_rollbacks:
+            flog.record("fail", cause="rollback budget exhausted")
+            print(
+                f"sentinel: {args.max_rollbacks} consecutive rollbacks "
+                "exhausted without progress; aborting",
+                file=sys.stderr,
+            )
+            return 3
+        student, opt_state, _ = load_state(student, opt_state)
+        i = last_good_step
+        return None
+
     while i < args.steps:
         x = jax.device_put(get_batch(i))
         y = teacher_fwd(teacher, x)
-        out = step_fn(student, opt_state, x, y)
+        try:
+            if sup is not None:
+                out = sup.supervise_step(student, opt_state, x, y, step=i)
+            else:
+                out = step_fn(student, opt_state, x, y)
+        except DegradationExhausted as e:
+            # Ladder spent mid-step: the checkpoint rollback is the floor.
+            rc = _rollback(f"elastic ladder exhausted: {str(e)[:120]}")
+            if rc is not None:
+                return rc
+            continue
         new_student, new_opt, loss = out[0], out[1], float(out[2])
         gnorm = float(out[3]) if len(out) > 3 else None
         ch = chaos.active()
@@ -171,24 +234,24 @@ def _run_resilient_loop(
                 if mesh is not None:
                     sentinel.check_divergence(i, new_student, "params")
         except SDC as e:
-            rollbacks += 1
-            flog.record("retry", cause=str(e)[:160])
-            jr.append("rollback", key=f"rollback:{i + 1}", step=i + 1, cause=str(e)[:200])
-            print(
-                f"{e} -> rollback to last-good step {last_good_step} "
-                f"(rollback {rollbacks}/{args.max_rollbacks})",
-                flush=True,
-            )
-            if rollbacks > args.max_rollbacks:
-                flog.record("fail", cause="rollback budget exhausted")
-                print(
-                    f"sentinel: {args.max_rollbacks} consecutive rollbacks "
-                    "exhausted without progress; aborting",
-                    file=sys.stderr,
-                )
-                return 3
-            student, opt_state, _ = load_state(student, opt_state)
-            i = last_good_step
+            if sup is not None:
+                # Step-level replay first: degrade, reshard the PRE-step
+                # state live, re-run the same batch — no rollback consumed,
+                # no checkpoint touched. The discarded new_student carries
+                # whatever tripped the screen.
+                try:
+                    student, opt_state = sup.trip_external(e, student, opt_state)
+                    print(
+                        f"{e} -> elastic replay of step {i + 1} on "
+                        f"{sup.entry.key} (no rollback consumed)",
+                        flush=True,
+                    )
+                    continue
+                except DegradationExhausted:
+                    pass  # ladder spent: fall through to the floor
+            rc = _rollback(str(e))
+            if rc is not None:
+                return rc
             continue
         student, opt_state = new_student, new_opt
         if first is None:
@@ -295,6 +358,13 @@ def main(argv=None) -> int:
 
     shape = (args.batch, cfg.in_height, cfg.in_width, cfg.in_channels)
     resilient = args.checkpoint_every > 0
+    if args.supervise_steps and not resilient:
+        print(
+            "--supervise-steps requires --checkpoint-every (checkpoint "
+            "rollback is the floor below the elastic ladder)",
+            file=sys.stderr,
+        )
+        return 2
 
     from .resilience import chaos
     from .resilience.policy import FaultLog
@@ -373,14 +443,39 @@ def main(argv=None) -> int:
         def get_batch(k: int):
             return native.fill_batch(shape, "uniform", native.batch_seed(args.seed, k))
 
+        sup = None
+        if args.supervise_steps:
+            from .resilience.supervisor import Supervisor, train_ladder
+            from .training import make_elastic_step_builder
+
+            # The elastic step ladder replaces the bare step_fn: same
+            # optimizer (opt-state trees stay portable across rungs), every
+            # sharded rung rebuilt over the supervisor pool's SURVIVING
+            # devices, trips replayed step-level before any rollback.
+            sup = Supervisor(
+                cfg,
+                train_ladder(sp_shards=args.sp, tp_shards=args.tp),
+                step_builder=make_elastic_step_builder(
+                    cfg, optimizer=opt, remat=args.remat,
+                    with_grad_norm=sentinel is not None,
+                ),
+                journal=jr,
+                site="train",
+            )
+
         rc = _run_resilient_loop(
             args, jr, save_state, load_state, start_step, get_batch, teacher_fwd,
             teacher, step_fn, student, opt_state, sentinel, mesh,
-            FaultLog(site="train-sentinel"),
+            FaultLog(site="train-sentinel"), sup=sup,
         )
         if isinstance(rc, int):
             return rc
         first, last, steps_run = rc
+        if sup is not None:
+            # Machine-parseable elastic summary (scripts/on_heal.sh gates
+            # on 'Elastic: .*replays='): rung, trip kinds, replay count,
+            # surviving pool.
+            print(f"Elastic: {sup.summary()}")
     else:
         try:
             loader_cm = native.NativeDataLoader(

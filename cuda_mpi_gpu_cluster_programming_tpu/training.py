@@ -108,6 +108,46 @@ def make_train_step(
     return opt.init, _build_step(loss_fn, pre=pre, post=post)
 
 
+def make_elastic_step_builder(
+    cfg: Blocks12Config = BLOCKS12,
+    optimizer: optax.GradientTransformation | None = None,
+    lr: float = 1e-3,
+    remat: bool = False,
+    with_grad_norm: bool = False,
+) -> Callable:
+    """``(entry, mesh) -> step_fn`` for the supervisor's step-replay path
+    (``resilience.supervisor.Supervisor(step_builder=...)``).
+
+    Maps a ladder rung onto :func:`make_train_step`'s strategies, building
+    against the SURVIVING-device mesh the supervisor passes after a shrink
+    — never a mesh of its own (the stale-device-set discipline). ONE
+    optimizer instance is shared across every rung, so the opt-state tree
+    stays structurally identical through a degrade and the live reshard is
+    a pure ``jax.device_put`` — no state translation, no checkpoint
+    round-trip.
+    """
+    opt = optimizer if optimizer is not None else optax.sgd(lr)
+
+    def build(entry, mesh) -> Callable:
+        if entry.strategy in ("halo", "staged_halo") and entry.n_shards >= 2:
+            return make_train_step(
+                cfg, mesh=mesh, optimizer=opt, sp_shards=entry.n_shards,
+                remat=remat, with_grad_norm=with_grad_norm,
+            )[1]
+        if entry.strategy == "tp" and entry.n_shards >= 2:
+            return make_train_step(
+                cfg, mesh=mesh, optimizer=opt, tp_shards=entry.n_shards,
+                remat=remat, with_grad_norm=with_grad_norm,
+            )[1]
+        if entry.strategy in ("single", "replicated") or entry.n_shards == 1:
+            return make_train_step(
+                cfg, optimizer=opt, remat=remat, with_grad_norm=with_grad_norm
+            )[1]
+        raise ValueError(f"no elastic training step for ladder entry {entry.key}")
+
+    return build
+
+
 def _jit_step(opt, loss_fn, pre=None, post=None, with_grad_norm=False) -> Callable:
     """The shared update scaffold: (optional pre-constraints) ->
     value_and_grad -> opt.update -> apply_updates -> (optional post) —

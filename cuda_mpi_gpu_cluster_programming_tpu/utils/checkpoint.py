@@ -17,7 +17,12 @@ Three formats:
   shard set is atomically replaced LAST (the commit point), and stale
   generations are garbage-collected only after the commit. A kill at ANY
   instant therefore leaves the manifest pointing at a fully-written
-  generation — the last-good tree always loads.
+  generation — the last-good tree always loads. The layout is
+  TOPOLOGY-PORTABLE: the manifest alone determines which shard holds
+  which leaf (``shard_layout``), and ``load_tree_sharded``/
+  ``load_train_state_sharded`` accept ``target_shards=``/``mesh=`` to
+  reassemble an n-way checkpoint bit-identically onto n/2, 2n or 1
+  devices (reshard-on-load — the restore side of parallel.elastic).
 - **orbax** — for large / sharded device trees; restores to the sharding
   of a provided target tree (multi-host safe).
 
@@ -222,11 +227,18 @@ def save_tree_sharded(
         directory / MANIFEST_NAME,
         json.dumps(
             {
-                "version": 1,
+                # v2: the manifest carries the full sorted key list, so the
+                # round-robin layout (key j -> shard j % n_shards) is
+                # derivable from the manifest ALONE (shard_layout) — the
+                # property that makes the format topology-portable: any
+                # loader can re-deal the same leaves onto a different shard
+                # or device count without trusting the file contents.
+                "version": 2,
                 "gen": gen,
                 "n_shards": n_shards,
                 "files": files,
                 "n_leaves": len(keys),
+                "keys": keys,
                 "meta": meta or {},
             },
             indent=2,
@@ -243,29 +255,83 @@ def save_tree_sharded(
     return directory
 
 
+def _check_shard_set(directory: Path, manifest: dict) -> None:
+    """Attributable pre-flight of the manifest-declared shard set.
+
+    A partially-GC'd / hand-pruned directory used to surface as an opaque
+    medium-blaming ValueError (or, with ``like=``, a bare KeyError on the
+    first absent leaf). Missing or miscounted shard files are a DIRECTORY
+    problem, not a torn write — say so, with the counts."""
+    files = manifest["files"]
+    declared = manifest.get("n_shards")
+    if declared is not None and int(declared) != len(files):
+        raise ValueError(
+            f"sharded checkpoint manifest {directory / MANIFEST_NAME} is "
+            f"malformed: declares n_shards={declared} but names "
+            f"{len(files)} shard files"
+        )
+    missing = sorted(f for f in files if not (directory / f).is_file())
+    if missing:
+        raise ValueError(
+            f"sharded checkpoint {directory}: manifest declares "
+            f"n_shards={declared if declared is not None else len(files)} "
+            f"({len(files)} shard files) but {len(missing)} are missing "
+            f"({', '.join(missing)}) — the directory was pruned outside "
+            "the saver (post-commit GC only deletes superseded "
+            "generations); restore the files or fall back to an older "
+            "checkpoint"
+        )
+
+
 def load_tree_sharded(
-    directory: str | Path, as_jax: bool = True, like: Optional[PyTree] = None
+    directory: str | Path,
+    as_jax: bool = True,
+    like: Optional[PyTree] = None,
+    *,
+    target_shards: Optional[int] = None,
+    mesh=None,
+    spec=None,
 ) -> Tuple[PyTree, dict]:
     """Load the last-good sharded tree: ``(tree, meta)``.
 
     Only files the manifest names are read — stale or half-written
-    generations are invisible. A missing/truncated shard file (a failing
-    medium; the saver cannot produce this state) raises the same uniform
+    generations are invisible. A missing shard file raises an attributable
+    ``ValueError`` naming the manifest-declared shard set vs. what the
+    directory holds; a truncated/corrupt one raises the same uniform
     ``ValueError`` the npz loader uses, so rollback policy catches one
     exception type for both formats.
+
+    **Reshard-on-load** (topology-portable checkpoints): the on-disk shard
+    count is a property of the SAVE, not a constraint on the restore — the
+    round-robin layout is derivable from the manifest alone
+    (:func:`shard_layout`), and leaves reassemble identically regardless
+    of how they were dealt. ``mesh=`` places the reassembled tree onto
+    that device mesh via ``jax.device_put`` (``spec=`` defaults to the
+    replicated ``P()`` layout); ``target_shards=N`` is the shorthand that
+    builds a fresh N-device mesh over the devices alive NOW. Either way an
+    n-way checkpoint restores bit-identically onto n/2, 2n, or 1 devices.
     """
     directory = Path(directory)
     manifest = _read_manifest(directory)
+    _check_shard_set(directory, manifest)
     flat: Dict[str, np.ndarray] = {}
     for fname in manifest["files"]:
         fpath = directory / fname
         try:
             with np.load(fpath) as archive:
                 for k in archive.files:
+                    if k in flat:
+                        raise ValueError(
+                            f"sharded checkpoint {directory}: leaf {k!r} "
+                            f"appears in more than one shard file — extra/"
+                            "overlapping shard content the round-robin "
+                            "saver cannot produce; the directory holds "
+                            "files from a foreign save"
+                        )
                     flat[k] = archive[k]
         except (zipfile.BadZipFile, EOFError, OSError) as e:
             raise ValueError(
-                f"sharded checkpoint shard {fpath} is missing, truncated or "
+                f"sharded checkpoint shard {fpath} is truncated or "
                 f"corrupt ({type(e).__name__}: {e}); the manifest-commit "
                 "saver cannot produce this — suspect the medium"
             ) from e
@@ -285,9 +351,40 @@ def load_tree_sharded(
         tree = jax.tree_util.tree_unflatten(treedef, leaves)
     else:
         tree = _unflatten(flat)
-    if as_jax:
+    if mesh is None and target_shards is not None:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(int(target_shards))
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, spec if spec is not None else PartitionSpec())
+        tree = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(np.asarray(leaf), sharding), tree
+        )
+    elif as_jax:
         tree = jax.tree_util.tree_map(jax.numpy.asarray, tree)
     return tree, manifest.get("meta", {})
+
+
+def shard_layout(directory: str | Path) -> Dict[str, str]:
+    """Map every leaf key to the shard file holding it, derived from the
+    manifest ALONE (sorted key order dealt round-robin: key j lands in
+    shard ``j % n_shards``) — no shard file is opened. This derivability
+    is what makes the layout topology-portable: a restore targeting a
+    different shard/device count re-deals the same keys without trusting
+    (or having) the original file set."""
+    directory = Path(directory)
+    manifest = _read_manifest(directory)
+    keys = manifest.get("keys")
+    if keys is None:
+        raise ValueError(
+            f"sharded checkpoint manifest in {directory} predates the "
+            "derivable-layout format (no 'keys' field; version "
+            f"{manifest.get('version')}) — re-save to upgrade"
+        )
+    files = manifest["files"]
+    return {key: files[j % len(files)] for j, key in enumerate(keys)}
 
 
 def save_train_state_sharded(
@@ -306,19 +403,36 @@ def save_train_state_sharded(
 
 
 def load_train_state_sharded(
-    directory: str | Path, like_params: PyTree, like_opt_state: PyTree
+    directory: str | Path,
+    like_params: PyTree,
+    like_opt_state: PyTree,
+    *,
+    target_shards: Optional[int] = None,
+    mesh=None,
 ) -> Tuple[PyTree, PyTree, int]:
     """Restore ``(params, opt_state, step)`` from a sharded-tree checkpoint
     into exactly the provided structures (same contract and exception types
-    as :func:`load_train_state`)."""
+    as :func:`load_train_state`).
+
+    ``target_shards=``/``mesh=`` reshard-on-load: the full train state —
+    optimizer state included — restores bit-identically onto a device
+    count DIFFERENT from the one that saved it (n/2 after a preemption
+    shrank the fleet, 2n after it grew back, 1 for the reference floor),
+    placed replicated on the target mesh ready for the elastic step path.
+    """
     like = {
         "params": like_params,
         "opt_state": like_opt_state,
         "step": np.zeros((), np.int64),
     }
-    tree, _meta = load_tree_sharded(directory, as_jax=False, like=like)
-    params = jax.tree_util.tree_map(jax.numpy.asarray, tree["params"])
-    opt_state = jax.tree_util.tree_map(jax.numpy.asarray, tree["opt_state"])
+    retarget = target_shards is not None or mesh is not None
+    tree, _meta = load_tree_sharded(
+        directory, as_jax=False, like=like, target_shards=target_shards, mesh=mesh
+    )
+    params, opt_state = tree["params"], tree["opt_state"]
+    if not retarget:
+        params = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        opt_state = jax.tree_util.tree_map(jax.numpy.asarray, opt_state)
     return params, opt_state, int(tree["step"])
 
 

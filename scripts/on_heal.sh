@@ -60,6 +60,29 @@ for drill in "v2.2_sharded stage_sdc=1" "v7_tp device_loss=1"; do
 done
 [ "$SUPERVISE_DRILL_OK" = 1 ] && say "supervisor drills OK (trip -> degrade -> replay proven on CPU)"
 
+say "elastic mesh-shrink drill (seeded mesh_shrink chaos on the CPU training mesh — docs/RESILIENCE.md 'True elastic meshes')"
+# The TRUE-elastic path is proven before chip time, same policy as above:
+# a seeded mesh_shrink during sharded training must actually drop a
+# device, rebuild the step over the surviving-device mesh, live-reshard
+# params+opt-state, and REPLAY the step — 'Elastic: ... replays=1' with
+# ZERO checkpoint rollbacks. A fleet that can only recover by draining
+# should learn that here, not mid-preemption.
+MS_LOG="logs/heal_mesh_shrink_${FTS}.log"
+if timeout 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    CHAOS_SPEC="seed=3,mesh_shrink=1" \
+    python -m cuda_mpi_gpu_cluster_programming_tpu.train \
+    --steps 3 --batch 2 --sp 4 --height 63 --width 63 \
+    --checkpoint-every 8 --supervise-steps --max-rollbacks 1 \
+    --work-dir "logs/heal_mesh_shrink_${FTS}" > "$MS_LOG" 2>&1 \
+    && grep -q "Elastic: .*replays=1" "$MS_LOG" \
+    && ! grep -q "rollback" "$MS_LOG"; then
+    grep -E "Elastic:" "$MS_LOG" | tee -a "$LOG" >/dev/null
+    say "mesh-shrink drill OK (step replayed on the surviving-device mesh, no rollback consumed; log: $MS_LOG)"
+else
+    say "MESH-SHRINK DRILL FAILED — elastic rebuild path broken; fix before relying on preemption-riding this window (log: $MS_LOG)"
+fi
+
 say "serve smoke (continuous-batching Poisson drill on the CPU mesh — docs/SERVING.md)"
 # The serving path is PROVEN before any heal-window chip time, same policy
 # as the supervisor drill above: a short journaled Poisson run through the

@@ -285,6 +285,127 @@ def test_sharded_train_state_roundtrip_and_like_structures(tmp_path):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_reshard_on_load_property_params(tmp_path):
+    """ISSUE 8: an n-way sharded checkpoint reassembles BIT-identically
+    onto n/2, 2n, and 1 target devices — the on-disk shard count is a
+    property of the save, never a constraint on the restore."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+
+    params = init_params_random(jax.random.PRNGKey(11))
+    for n, m in [(4, 2), (2, 4), (4, 1), (3, 8)]:
+        d = tmp_path / f"ck_{n}_{m}"
+        ckpt.save_tree_sharded(d, params, n_shards=n, meta={"n": n})
+        tree, meta = ckpt.load_tree_sharded(d, target_shards=m)
+        assert meta == {"n": n}
+        assert jax.tree_util.tree_structure(tree) == jax.tree_util.tree_structure(params)
+        want = NamedSharding(make_mesh(m), P())
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(tree)
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))  # bit-exact
+            assert b.sharding == want  # placed on the TARGET topology
+
+
+def test_reshard_on_load_train_state(tmp_path):
+    """The full train state (opt state included) restores onto n/2 and 2n
+    shard counts bit-identically, placed replicated on the target mesh —
+    the restore side of the elastic-mesh story."""
+    import optax
+
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.elastic import (
+        tree_device_ids,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+
+    params = init_params_random(jax.random.PRNGKey(12))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    d = tmp_path / "state"
+    ckpt.save_train_state_sharded(d, params, opt_state, step=5, n_shards=4)
+    for m in (2, 8):
+        p2, o2, step = ckpt.load_train_state_sharded(
+            d, params, opt.init(params), target_shards=m
+        )
+        assert step == 5
+        assert jax.tree_util.tree_structure(o2) == jax.tree_util.tree_structure(opt_state)
+        for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(p2)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(opt_state), jax.tree_util.tree_leaves(o2)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        ids = {dev.id for dev in make_mesh(m).devices.flat}
+        assert tree_device_ids(p2) == ids and tree_device_ids(o2) == ids
+    # mesh= places onto an explicit (e.g. surviving-device) mesh directly.
+    mesh = make_mesh(2, devices=jax.devices()[4:])
+    p3, _o3, _ = ckpt.load_train_state_sharded(d, params, opt.init(params), mesh=mesh)
+    assert tree_device_ids(p3) == {dev.id for dev in mesh.devices.flat}
+
+
+def test_shard_layout_derivable_from_manifest_alone(tmp_path):
+    """The manifest's (n_shards, key order) fully determines the
+    round-robin layout: shard_layout opens no shard file, yet names the
+    exact file holding every leaf."""
+    import numpy as onp
+
+    params = init_params_random(jax.random.PRNGKey(13))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=3)
+    layout = ckpt.shard_layout(d)
+    # Verify against the actual shard contents.
+    actual = {}
+    for f in json.loads((d / ckpt.MANIFEST_NAME).read_text())["files"]:
+        with onp.load(d / f) as archive:
+            for k in archive.files:
+                actual[k] = f
+    assert layout == actual
+    # Pre-keys (v1) manifests refuse derivation attributably.
+    manifest = json.loads((d / ckpt.MANIFEST_NAME).read_text())
+    del manifest["keys"]
+    (d / ckpt.MANIFEST_NAME).write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="keys"):
+        ckpt.shard_layout(d)
+
+
+def test_missing_shard_files_raise_attributable_error(tmp_path):
+    """ISSUE 8 bugfix: a partially-GC'd/hand-pruned directory names the
+    manifest-declared shard set vs. what the directory holds — not a
+    medium-blaming ValueError, and never a bare KeyError on the like=
+    path."""
+    params = init_params_random(jax.random.PRNGKey(14))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=3)
+    victim = json.loads((d / ckpt.MANIFEST_NAME).read_text())["files"][1]
+    (d / victim).unlink()  # the partially-GC'd directory
+    with pytest.raises(ValueError, match="n_shards=3") as ei:
+        ckpt.load_tree_sharded(d)
+    assert victim in str(ei.value) and "pruned outside the saver" in str(ei.value)
+    # like= takes the same attributable path (previously a KeyError).
+    with pytest.raises(ValueError, match="missing"):
+        ckpt.load_tree_sharded(d, like=params)
+    # A manifest whose file list disagrees with its own n_shards is called
+    # malformed, with both numbers.
+    manifest = json.loads((d / ckpt.MANIFEST_NAME).read_text())
+    manifest["files"] = manifest["files"][:2]
+    (d / ckpt.MANIFEST_NAME).write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="declares n_shards=3 but names 2"):
+        ckpt.load_tree_sharded(d)
+
+
+def test_extra_overlapping_shard_content_raises(tmp_path):
+    """A manifest naming the same shard twice (foreign/extra content) is an
+    attributable duplicate-leaf error, not silent double-assignment."""
+    params = init_params_random(jax.random.PRNGKey(15))
+    d = tmp_path / "ck"
+    ckpt.save_tree_sharded(d, params, n_shards=2)
+    manifest = json.loads((d / ckpt.MANIFEST_NAME).read_text())
+    manifest["files"] = [manifest["files"][0]] + manifest["files"]
+    manifest["n_shards"] = 3
+    (d / ckpt.MANIFEST_NAME).write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="more than one shard file"):
+        ckpt.load_tree_sharded(d)
+
+
 def test_train_state_roundtrip_sgd_and_adam(tmp_path):
     """(params, opt_state, step) survive the roundtrip bit-exact into the
     exact optimizer-state structure (tuples/namedtuples need like=)."""

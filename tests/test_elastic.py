@@ -1,0 +1,391 @@
+"""True elastic meshes — CPU, virtual 8-device mesh.
+
+The ISSUE 8 tentpole surface: the surviving-device pool (re-query
+discipline, seeded losses, journaled shrinks), live resharding of params /
+optimizer state via ``jax.device_put``, supervisor-managed TRAINING steps
+(mesh-shrink trip → rebuild over survivors → reshard → step-level replay,
+bit-identical to a run pinned to the shrunken mesh, no rollback consumed),
+and the train CLI ``--supervise-steps`` acceptance drill.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    init_params_deterministic,
+    init_params_random,
+    random_input,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.parallel.elastic import (
+    ElasticPool,
+    reshard_train_state,
+    reshard_tree,
+    seeded_victims,
+    tree_device_ids,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh
+from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
+    DegradationExhausted,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.sentinel import SDC
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.supervisor import (
+    Supervisor,
+    train_ladder,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.training import (
+    make_elastic_step_builder,
+    make_train_step,
+)
+
+CFG = dataclasses.replace(BLOCKS12, in_height=63, in_width=63)
+
+
+def _chaos(monkeypatch, spec):
+    if spec is None:
+        monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    else:
+        monkeypatch.setenv(chaos.CHAOS_ENV, spec)
+    chaos.reset()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off(monkeypatch):
+    _chaos(monkeypatch, None)
+    yield
+    chaos.reset()
+
+
+def _trees_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+# ------------------------------------------------------------------ pool ---
+
+
+def test_pool_tracks_losses_and_requeries():
+    pool = ElasticPool()
+    assert pool.n_total == 8 and pool.n_alive == 8 and pool.n_lost == 0
+    victims = pool.alive()[5:7]
+    rec = pool.lose(victims)
+    assert rec["before"] == 8 and rec["after"] == 6
+    assert pool.n_alive == 6 and pool.n_lost == 2
+    # alive() re-queries and filters — the victims never reappear.
+    assert {d.id for d in pool.alive()}.isdisjoint({d.id for d in victims})
+    mesh = pool.mesh_for(4)
+    assert set(mesh.devices.flat) <= set(pool.alive())
+    assert pool.summary() == "6/8"
+
+
+def test_pool_refuses_to_lose_all_and_unsatisfiable_mesh_raises():
+    pool = ElasticPool()
+    with pytest.raises(ValueError, match="refusing to lose all"):
+        pool.lose(pool.alive())
+    pool.lose(pool.alive()[3:])  # 8 -> 3 survivors
+    with pytest.raises(ValueError, match="devices"):
+        pool.mesh_for(4)  # the degrade loop's "rung unsatisfiable" signal
+    assert pool.mesh_for(2).devices.size == 2
+
+
+def test_pool_shrink_is_journaled(tmp_path):
+    jr = Journal(tmp_path / "pool.jsonl")
+    pool = ElasticPool(journal=jr, site="drill")
+    pool.lose(pool.alive()[6:], cause="chaos:mesh_shrink")
+    (rec,) = Journal.load(tmp_path / "pool.jsonl")
+    assert rec["kind"] == "mesh_shrink"
+    assert rec["before"] == 8 and rec["after"] == 6
+    assert rec["cause"] == "chaos:mesh_shrink" and rec["site"] == "drill"
+    assert len(rec["lost"]) == 2
+
+
+def test_seeded_victims_deterministic_and_spare_first_survivor():
+    pool = ElasticPool()
+    a = seeded_victims(pool, 3, 7)
+    b = seeded_victims(pool, 3, 7)
+    assert a == b and len(a) == 3
+    assert pool.alive()[0] not in a  # the floor's device is never a victim
+    # k is clamped so at least one device survives.
+    assert len(seeded_victims(pool, 99, 7)) == 7
+
+
+# --------------------------------------------------------------- reshard ---
+
+
+def test_reshard_tree_moves_values_untouched():
+    params = init_params_random(jax.random.PRNGKey(0), CFG)
+    pool = ElasticPool()
+    pool.lose(pool.alive()[2:3])
+    mesh = pool.mesh_for(4)
+    placed = reshard_tree(params, mesh)
+    assert _trees_equal(params, placed)
+    want = NamedSharding(mesh, P())
+    for leaf in jax.tree_util.tree_leaves(placed):
+        assert leaf.sharding == want
+    # Placement followed the pool: no leaf lives on the lost device.
+    assert tree_device_ids(placed) <= {d.id for d in pool.alive()}
+
+
+def test_reshard_train_state_covers_opt_state():
+    params = init_params_random(jax.random.PRNGKey(1), CFG)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    mesh = make_mesh(2)
+    p2, o2 = reshard_train_state(params, opt_state, mesh)
+    assert _trees_equal(params, p2) and _trees_equal(opt_state, o2)
+    assert jax.tree_util.tree_structure(o2) == jax.tree_util.tree_structure(
+        opt_state
+    )
+    ids = {d.id for d in mesh.devices.flat}
+    assert tree_device_ids(p2) == ids and tree_device_ids(o2) == ids
+
+
+# ------------------------------------------------- supervised train steps ---
+
+
+def _case(sp=4, steps=3, batch=2, lr=1e-3):
+    teacher = init_params_deterministic(CFG)
+    teacher_fwd = jax.jit(
+        lambda p, x: __import__(
+            "cuda_mpi_gpu_cluster_programming_tpu.models.alexnet",
+            fromlist=["forward_blocks12"],
+        ).forward_blocks12(p, x, CFG)
+    )
+    student = init_params_random(jax.random.PRNGKey(0), CFG)
+    keys = jax.random.split(jax.random.PRNGKey(9), steps)
+    xs = [random_input(k, batch, CFG) for k in keys]
+    ys = [teacher_fwd(teacher, x) for x in xs]
+    return student, xs, ys
+
+
+def test_train_ladder_shape():
+    assert [e.key for e in train_ladder(sp_shards=8)] == [
+        "halo@8:reference", "halo@4:reference", "halo@2:reference",
+        "single@1:reference",
+    ]
+    assert [e.key for e in train_ladder(tp_shards=4)] == [
+        "tp@4:reference", "tp@2:reference", "single@1:reference"
+    ]
+    assert [e.key for e in train_ladder()] == ["single@1:reference"]
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        train_ladder(sp_shards=2, tp_shards=2)
+
+
+def test_supervise_step_requires_builder():
+    sup = Supervisor(CFG, train_ladder(sp_shards=2))
+    with pytest.raises(ValueError, match="step_builder"):
+        sup.supervise_step({}, {}, None, None)
+
+
+def test_supervise_step_clean_matches_plain_step():
+    student, xs, ys = _case(steps=1)
+    opt = optax.sgd(1e-3)
+    sup = Supervisor(
+        CFG, train_ladder(sp_shards=4),
+        step_builder=make_elastic_step_builder(CFG, optimizer=opt),
+    )
+    out = sup.supervise_step(student, opt.init(student), xs[0], ys[0], step=0)
+    _, plain_step = make_train_step(
+        CFG, mesh=make_mesh(4), optimizer=opt, sp_shards=4
+    )
+    want = plain_step(student, opt.init(student), xs[0], ys[0])
+    assert sup.trips == [] and sup.replays == 0
+    assert _trees_equal(out[0], want[0]) and _trees_equal(out[1], want[1])
+    assert float(out[2]) == float(want[2])
+
+
+def test_mesh_shrink_drill_replays_step_on_surviving_mesh(
+    monkeypatch, tmp_path
+):
+    """The tentpole drill: mesh_shrink=2 at the first supervised step
+    actually loses 2 devices, the step rebuilds on halo@2 over survivors,
+    reshards live (params, opt_state), replays the SAME batch, and the
+    whole 3-step trajectory is BIT-identical to an uninjected run pinned
+    to the shrunken rung."""
+    student, xs, ys = _case(steps=3)
+    opt = optax.sgd(1e-3)
+    _chaos(monkeypatch, "seed=3,mesh_shrink=2")
+    sup = Supervisor(
+        CFG, train_ladder(sp_shards=4),
+        step_builder=make_elastic_step_builder(CFG, optimizer=opt),
+        journal=Journal(tmp_path / "sup.jsonl"),
+    )
+    params, opt_state = student, opt.init(student)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        out = sup.supervise_step(params, opt_state, x, y, step=i)
+        params, opt_state = out[0], out[1]
+    assert [t.kind for t in sup.trips] == ["mesh_shrink"]
+    assert sup.replays == 1
+    assert sup.pool.n_total == 8 and sup.pool.n_alive == 6
+    assert sup.entry.key == "halo@2:reference"
+    kinds = [r["kind"] for r in Journal.load(tmp_path / "sup.jsonl")]
+    assert kinds.count("sup_step") == 3 and kinds.count("sup_replay") == 1
+    assert "mesh_shrink" in kinds  # the pool's shrink record rides along
+
+    # Uninjected oracle pinned to the shrunken mesh: every step at sp=2.
+    _chaos(monkeypatch, None)
+    opt2 = optax.sgd(1e-3)
+    _, step2 = make_train_step(CFG, mesh=make_mesh(2), optimizer=opt2, sp_shards=2)
+    p2, o2 = student, opt2.init(student)
+    for x, y in zip(xs, ys):
+        out2 = step2(p2, o2, x, y)
+        p2, o2 = out2[0], out2[1]
+    assert _trees_equal(params, p2)
+    assert _trees_equal(opt_state, o2)
+
+
+def test_mesh_shrink_count_is_magnitude_one_event(monkeypatch):
+    """``mesh_shrink=k`` is ONE shrink losing k devices (chaos.drain), not
+    k separate trips."""
+    student, xs, ys = _case(steps=2)
+    opt = optax.sgd(1e-3)
+    _chaos(monkeypatch, "seed=3,mesh_shrink=3")
+    sup = Supervisor(
+        CFG, train_ladder(sp_shards=4),
+        step_builder=make_elastic_step_builder(CFG, optimizer=opt),
+    )
+    params, opt_state = student, opt.init(student)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        out = sup.supervise_step(params, opt_state, x, y, step=i)
+        params, opt_state = out[0], out[1]
+    assert [t.kind for t in sup.trips] == ["mesh_shrink"]  # one event
+    assert sup.pool.n_alive == 5  # ... of magnitude 3
+
+
+def test_supervise_step_nonfinite_loss_trips_and_degrades():
+    student, xs, ys = _case(steps=1)
+    opt = optax.sgd(1e-3)
+    base = make_elastic_step_builder(CFG, optimizer=opt)
+
+    def poisoned(entry, mesh):
+        fn = base(entry, mesh)
+        if entry.n_shards == 4:  # only the top rung is broken
+            def bad(p, o, x, y):
+                out = fn(p, o, x, y)
+                return out[0], out[1], jnp.float32(float("nan"))
+
+            return bad
+        return fn
+
+    sup = Supervisor(CFG, train_ladder(sp_shards=4), step_builder=poisoned)
+    out = sup.supervise_step(student, opt.init(student), xs[0], ys[0], step=0)
+    assert [t.kind for t in sup.trips] == ["step_nonfinite"]
+    assert sup.entry.key == "halo@2:reference"
+    assert np.isfinite(float(out[2]))
+
+
+def test_trip_external_reshards_then_exhausts_to_caller():
+    """The train loop's sentinel-trip router: each external trip degrades
+    one rung and returns the resharded live state; a spent ladder raises
+    DegradationExhausted (the caller's checkpoint rollback is the floor)."""
+    student, _, _ = _case(steps=1)
+    opt = optax.sgd(1e-3)
+    ladder = train_ladder(sp_shards=4)  # 3 rungs
+    sup = Supervisor(
+        CFG, ladder, step_builder=make_elastic_step_builder(CFG, optimizer=opt)
+    )
+    params, opt_state = student, opt.init(student)
+    for hop in range(len(ladder) - 1):
+        params, opt_state = sup.trip_external(
+            SDC("norm_spike", hop, "drill"), params, opt_state
+        )
+        assert _trees_equal(params, student)
+    assert sup.entry.key == "single@1:reference"
+    assert sup.replays == len(ladder) - 1
+    with pytest.raises(DegradationExhausted):
+        sup.trip_external(SDC("norm_spike", 9, "drill"), params, opt_state)
+
+
+# ------------------------------------------------------------- train CLI ---
+
+
+def _losses(out):
+    return [float(l.split("loss = ")[1]) for l in out.splitlines() if "loss = " in l]
+
+
+def test_train_cli_mesh_shrink_acceptance(tmp_path, capsys, monkeypatch):
+    """ISSUE 8 acceptance: a seeded mesh_shrink drill during sharded
+    training replays the failed step on the surviving-device mesh and
+    finishes with a final param tree bit-identical to an uninjected run
+    pinned to that shrunken mesh — no checkpoint rollback consumed."""
+    from cuda_mpi_gpu_cluster_programming_tpu import train
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.checkpoint import (
+        load_params_npz,
+    )
+
+    common = ["--steps", "3", "--batch", "2", "--height", "63", "--width", "63",
+              "--checkpoint-every", "8"]
+    _chaos(monkeypatch, "seed=3,mesh_shrink=1")
+    rc = train.main(
+        common + ["--sp", "4", "--supervise-steps",
+                  "--work-dir", str(tmp_path / "drill"),
+                  "--checkpoint", str(tmp_path / "drill.npz")]
+    )
+    drilled = capsys.readouterr().out
+    assert rc == 0
+    assert "Elastic: " in drilled and "replays=1" in drilled
+    assert "kinds=mesh_shrink" in drilled and "pool=7/8" in drilled
+    assert "rollback" not in drilled  # step-level replay, not the floor
+    records = Journal.load(tmp_path / "drill" / "journal.jsonl")
+    kinds = [r["kind"] for r in records]
+    assert "sup_replay" in kinds and "mesh_shrink" in kinds
+    assert "rollback" not in kinds
+    assert kinds.count("step") == 3
+
+    # Uninjected run PINNED to the shrunken mesh (sp=2, same seed/batches).
+    _chaos(monkeypatch, None)
+    rc = train.main(
+        common + ["--sp", "2", "--work-dir", str(tmp_path / "pin"),
+                  "--checkpoint", str(tmp_path / "pin.npz")]
+    )
+    pinned = capsys.readouterr().out
+    assert rc == 0
+    assert _losses(drilled) == _losses(pinned)
+    assert _trees_equal(
+        load_params_npz(tmp_path / "drill.npz"),
+        load_params_npz(tmp_path / "pin.npz"),
+    )
+
+
+def test_train_cli_supervise_steps_requires_checkpointing(capsys):
+    from cuda_mpi_gpu_cluster_programming_tpu import train
+
+    rc = train.main(["--steps", "1", "--supervise-steps"])
+    assert rc == 2
+    assert "--checkpoint-every" in capsys.readouterr().err
+
+
+def test_train_cli_sentinel_trip_routes_to_replay_not_rollback(
+    tmp_path, capsys, monkeypatch
+):
+    """An injected nan_loss under --supervise-steps is answered by a
+    step-level replay on the next rung — the checkpoint is never touched
+    and the committed trajectory matches the clean run of the same
+    ladder's SECOND rung from that step on."""
+    from cuda_mpi_gpu_cluster_programming_tpu import train
+
+    common = ["--steps", "3", "--batch", "2", "--height", "63", "--width", "63",
+              "--checkpoint-every", "8", "--sp", "2"]
+    _chaos(monkeypatch, "nan_loss=1")
+    rc = train.main(
+        common + ["--supervise-steps", "--work-dir", str(tmp_path / "w")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "chaos: injected nan_loss" in out
+    assert "elastic replay of step 1" in out and "no rollback consumed" in out
+    kinds = [r["kind"] for r in Journal.load(tmp_path / "w" / "journal.jsonl")]
+    assert "rollback" not in kinds
+    assert "sup_trip" in kinds and "sup_replay" in kinds
+    assert kinds.count("step") == 3

@@ -185,6 +185,19 @@ def test_chaos_maybe_raise_and_every_known_site():
 def test_chaos_known_sites_include_sdc_and_nan_loss():
     assert "sdc" in chaos.KNOWN_SITES
     assert "nan_loss" in chaos.KNOWN_SITES
+    assert "mesh_shrink" in chaos.KNOWN_SITES  # PR 8: elastic-mesh drills
+
+
+def test_chaos_drain_consumes_count_as_one_magnitude():
+    """``drain`` hands the whole remaining count to ONE event (the
+    mesh_shrink=k 'drop k devices at once' semantics) and leaves
+    probabilistic streams to ``draw``."""
+    inj = chaos.ChaosInjector(chaos.ChaosSpec.parse("mesh_shrink=3,ssh=1"))
+    assert inj.drain("mesh_shrink") == 3
+    assert inj.drain("mesh_shrink") == 0  # consumed: one event, not three
+    assert not inj.draw("mesh_shrink")
+    assert inj.fired == {"mesh_shrink": 3}
+    assert inj.draw("ssh")  # other sites untouched
 
 
 def test_chaos_unknown_fault_kind_is_value_error_listing_valid_kinds():

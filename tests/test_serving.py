@@ -348,6 +348,49 @@ def test_chaos_device_loss_drill_replays_in_flight_bit_identical(
         assert np.array_equal(a.result, b.result)
 
 
+def test_chaos_mesh_shrink_drill_server_survives_with_zero_misses(
+    tmp_path, monkeypatch
+):
+    """ISSUE 8 serving drill: a seeded mesh_shrink ACTUALLY drops devices
+    mid-load; the supervisor rebuilds the rung over the survivors,
+    live-reshards the params, re-warms every bucket, and replays — the
+    server finishes with completed == n_requests and ZERO post-rewarm
+    cache misses, bit-identical to a clean server pinned to the landed
+    rung."""
+    jpath = tmp_path / "serve.jsonl"
+    scfg = ServeConfig(config="v2.2_sharded", n_shards=4, max_batch=4,
+                       supervise=True, model_cfg=CFG, journal_path=str(jpath))
+    imgs = [_img(1.0 + 0.01 * i) for i in range(6)]
+
+    monkeypatch.setenv(chaos.CHAOS_ENV, "seed=3,mesh_shrink=2")
+    chaos.reset()
+    shrunk = InferenceServer(scfg)
+    handles = [shrunk.submit(im) for im in imgs]
+    shrunk.run_until_drained()
+    monkeypatch.delenv(chaos.CHAOS_ENV)
+    chaos.reset()
+
+    assert sum(1 for h in handles if h.status == OK) == len(imgs)
+    assert [t.kind for t in shrunk.sup.trips] == ["mesh_shrink"]
+    assert shrunk.sup.pool.n_total == 8 and shrunk.sup.pool.n_alive == 6
+    assert shrunk.sup.entry.key == "halo@2:reference"  # the surviving rung
+    assert shrunk.stats.cache_misses == 0  # zero post-rewarm misses
+    assert shrunk.stats.rewarm_ms > 0
+    kinds = [r["kind"] for r in Journal.load(jpath)]
+    assert "mesh_shrink" in kinds  # the pool's shrink record
+    assert kinds.index("serve_rewarm") < kinds.index("serve_batch")
+
+    clean = InferenceServer(
+        dataclasses.replace(scfg, journal_path=""),
+        ladder=[shrunk.sup.entry],
+    )
+    clean_handles = [clean.submit(im) for im in imgs]
+    clean.run_until_drained()
+    for a, b in zip(handles, clean_handles):
+        assert b.status == OK
+        assert np.array_equal(a.result, b.result)
+
+
 def test_threaded_poisson_load_accounts_for_every_request(tmp_path):
     jpath = tmp_path / "serve.jsonl"
     srv = InferenceServer(
@@ -442,5 +485,14 @@ def test_bench_serve_mode_cpu_smoke(tmp_path):
     assert drill["trips"] == ["device_loss"]
     assert drill["replayed_in_flight"] is True
     assert drill["bit_identical"] is True
+    # ISSUE 8: the drill sub-object's mesh_shrink row — the elastic path's
+    # machine-comparable trajectory across BENCH_r* rounds.
+    shrink = drill["mesh_shrink"]
+    assert shrink["completed"] == shrink["n_requests"]
+    assert shrink["trips"] == ["mesh_shrink"]
+    assert shrink["devices_after"] < shrink["devices_before"]
+    assert shrink["replayed"] == 1
+    assert shrink["rewarm_ms"] > 0
+    assert shrink["cache_misses_post_rewarm"] == 0
     # the journal backs the reported percentiles
     assert len(request_latencies_from_journal(jpath)) == row["n_ok"]

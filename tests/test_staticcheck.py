@@ -115,8 +115,19 @@ _VMA_OFF = (
     "check-vma-disabled",
     5,
 )
+_STALE_DEVICES = (
+    "staledev.py",
+    "import jax\n"
+    "from jax.sharding import Mesh\n"
+    "DEVICES = jax.devices()\n"        # cached at import: stale by rebuild
+    "def rebuild(n):\n"
+    "    return Mesh(DEVICES[:n], ('sp',))\n",
+    "stale-device-set",
+    5,
+)
 ALL_FIXTURES = [
     _WRONG_AXIS, _UNREDUCED, _HOST_SYNC, _KEY_REUSE, _JIT_IN_LOOP, _VMA_OFF,
+    _STALE_DEVICES,
 ]
 
 
@@ -417,6 +428,40 @@ def test_check_vma_computed_value_ok(tmp_path):
     assert findings_for(p, "check-vma-disabled") == []
 
 
+def test_stale_device_set_requery_and_module_scope_ok(tmp_path):
+    """The sanctioned patterns stay silent: re-querying jax.devices() at
+    build time inside the function, and a module-scope mesh build (runs at
+    import, when the cached list is still fresh)."""
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "import jax\n"
+        "from jax.sharding import Mesh\n"
+        "DEVICES = jax.devices()\n"
+        "TOP_MESH = Mesh(DEVICES, ('sp',))\n"   # import-time: fresh
+        "def rebuild(n):\n"
+        "    return Mesh(jax.devices()[:n], ('sp',))\n"  # re-query: fresh
+        "def helper(devs, n):\n"
+        "    return Mesh(devs[:n], ('sp',))\n"  # caller-supplied: not judged
+    )
+    assert findings_for(p, "stale-device-set") == []
+
+
+def test_stale_device_set_make_mesh_kwarg_and_list_wrap(tmp_path):
+    """make_mesh(devices=CACHED) and list(jax.devices()) caches are the
+    same bug in different spelling — both flagged."""
+    p = tmp_path / "kw.py"
+    p.write_text(
+        "import jax\n"
+        "from cuda_mpi_gpu_cluster_programming_tpu.parallel.mesh import make_mesh\n"
+        "ALL = list(jax.devices())\n"
+        "def retry_build(n):\n"
+        "    return make_mesh(n, devices=ALL)\n"
+    )
+    found = findings_for(p, "stale-device-set")
+    assert [f.line for f in found] == [5]
+    assert "re-query" in found[0].message
+
+
 def test_implicit_upcast_triggers_in_hot_path_dirs(tmp_path):
     """ISSUE 7 satellite: a contraction over bf16/int8-cast operands with
     no explicit preferred_element_type, in a hot-path module, is flagged —
@@ -651,6 +696,7 @@ def test_cli_list_rules_has_all_new_codes():
     for code in (
         "collective-axis", "unreduced-contraction", "host-sync-in-hot-loop",
         "key-reuse", "jit-in-loop", "check-vma-disabled", "implicit-upcast",
+        "stale-device-set",
         "raw-subprocess", "atomic-write", "variant-env", "deprecated",
     ):
         assert code in proc.stdout, code

@@ -182,7 +182,12 @@ def test_stage_sdc_drill_sp_forward_trips_degrades_replays(
     ]
     assert sup.attempts == 2  # trip + replay
     kinds = [r["kind"] for r in Journal.load(tmp_path / "sup.jsonl")]
-    assert kinds == ["sup_build", "sup_trip", "sup_degrade", "sup_build", "sup_ok"]
+    # PR 8: the degrade additionally journals the live reshard onto the
+    # landed rung's mesh and the replay itself, before the sup_ok.
+    assert kinds == [
+        "sup_build", "sup_trip", "sup_degrade", "sup_build",
+        "sup_reshard", "sup_replay", "sup_ok",
+    ]
 
 
 def test_stage_sdc_replay_bit_identical_to_uninjected_rung(
