@@ -180,6 +180,36 @@ def _error_json(msg: str, platform: str = "unknown") -> str:
     return json.dumps(_error_obj(msg, platform))
 
 
+def _stage_breakdown(tier: str, dtype: str, params, x, platform: str,
+                     model_cfg=None) -> dict:
+    """The per-stage ``breakdown`` sub-object (docs/OBSERVABILITY.md):
+    attribution at the sentinel tap boundaries via timed staged
+    re-execution, strictly after the headline measurement. Degrades to a
+    visible note instead of mislabeling: int8w has no staged-chain
+    analogue, and interpret-mode Pallas staging on CPU would attribute
+    tracing overhead, not kernels. BENCH_BREAKDOWN=0 disables,
+    BENCH_BREAKDOWN_REPEATS sizes the per-prefix chains."""
+    if dtype not in ("fp32", "bf16"):
+        return {"skipped": f"no staged-chain analogue for dtype {dtype!r}"}
+    if tier == "pallas" and platform == "cpu":
+        return {"skipped": "pallas staging runs interpret-mode on cpu "
+                           "(attribute on chip)"}
+    try:
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.stages import (
+            attribute_stages,
+        )
+
+        return attribute_stages(
+            params, x, model_cfg,
+            tier=tier,
+            compute=dtype,
+            repeats=int(os.environ.get("BENCH_BREAKDOWN_REPEATS", "3")),
+            warmup=1,
+        ).to_obj()
+    except Exception as e:  # evidence, not the headline — degrade visibly
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _child() -> int:
     """The actual measurement (runs inside a bounded subprocess)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -323,6 +353,16 @@ def _child() -> int:
             "config": cfg_key,
             "batch": BATCH,
         }
+        if (
+            os.environ.get("BENCH_BREAKDOWN", "1") != "0"
+            and REGISTRY[cfg_key].model == "blocks12"
+        ):
+            # Per-stage attribution beside the headline (stage sum vs
+            # per_pass_ms is the sums-to-total contract) — what the
+            # paper's tables report, machine-comparable across BENCH_r*.
+            out["breakdown"] = _stage_breakdown(
+                REGISTRY[cfg_key].tier, DTYPE, params, x, platform
+            )
         if plan is not None:
             # Tuned-vs-default on the SAME estimator: the headline row above
             # ran under the plan; re-measure with the plan stripped so the
@@ -567,16 +607,30 @@ def _serve_main() -> int:
             model_cfg=model_cfg,
         )
         server = InferenceServer(scfg)
-        server.start()
+        # Span tracing over the SAME serve journal (docs/OBSERVABILITY.md):
+        # the emitted row's journal path exports directly into a Perfetto
+        # timeline with queue-wait/dispatch spans beside their serve_batch
+        # records (on_heal.sh's logs/trace_serve_* artifact).
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.trace import (
+            Tracer,
+            set_tracer,
+        )
+
+        tracer = Tracer(journal=server.journal)
+        set_tracer(tracer)
         try:
-            report = run_load(
-                server,
-                rate_rps=float(os.environ.get("BENCH_SERVE_RATE", "50")),
-                duration_s=float(os.environ.get("BENCH_SERVE_DURATION", "3")),
-                seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
-            )
+            server.start()
+            try:
+                report = run_load(
+                    server,
+                    rate_rps=float(os.environ.get("BENCH_SERVE_RATE", "50")),
+                    duration_s=float(os.environ.get("BENCH_SERVE_DURATION", "3")),
+                    seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+                )
+            finally:
+                server.stop()
         finally:
-            server.stop()
+            set_tracer(None)
         # p50/p99 from the JOURNAL, not the in-memory report: the
         # crash-consistent trail is the number of record (the report's
         # handle-side percentiles cross-check it in tests).
@@ -608,10 +662,41 @@ def _serve_main() -> int:
             "supervise": scfg.supervise,
             "platform": platform,
             "journal": journal_path,
+            # The run's trace id (observability.trace): every span in the
+            # journal carries it, so the row and its timeline correlate.
+            "trace_id": tracer.trace_id,
         }
         if server.sup is not None:
             row["trips"] = [t.kind for t in server.sup.trips]
             row["entry"] = server.sup.entry.key
+        if os.environ.get("BENCH_BREAKDOWN", "1") != "0":
+            # Per-stage attribution at the bucket the service actually
+            # dispatches at — the serve row's analogue of the measure
+            # row's sums-to-total breakdown (docs/OBSERVABILITY.md).
+            from cuda_mpi_gpu_cluster_programming_tpu.configs import REGISTRY
+            from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+                deterministic_input,
+                init_params_deterministic,
+            )
+
+            bucket = server.buckets[-1]
+            row["breakdown"] = _stage_breakdown(
+                REGISTRY[scfg.config].tier, scfg.compute,
+                init_params_deterministic(model_cfg),
+                deterministic_input(bucket, model_cfg),
+                platform, model_cfg=model_cfg,
+            )
+        # The process-wide metrics registry the serving layer records into
+        # (docs/OBSERVABILITY.md): counters + nearest-rank histogram
+        # summaries beside the journal-derived percentiles above;
+        # BENCH_METRICS=<path> additionally writes the atomic JSONL export.
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.metrics import (
+            registry as metrics_registry,
+        )
+
+        row["metrics"] = metrics_registry().summary()
+        if os.environ.get("BENCH_METRICS"):
+            metrics_registry().export(os.environ["BENCH_METRICS"])
         if os.environ.get("BENCH_SERVE_DRILL", "1") != "0":
             try:
                 row["drill"] = _serve_drill(model_cfg)

@@ -26,6 +26,7 @@ import argparse
 import csv
 import hashlib
 import json
+import math
 import re
 import sqlite3
 import statistics
@@ -125,6 +126,15 @@ def connect(db_path: str | Path) -> sqlite3.Connection:
     path.parent.mkdir(parents=True, exist_ok=True)
     conn = sqlite3.connect(path)
     conn.create_aggregate("stddev_samp", 1, _Stdev)
+    # SQLite's built-in math functions (SQRT among them) are a compile-time
+    # option (-DSQLITE_ENABLE_MATH_FUNCTIONS) this image's build lacks —
+    # register a Python sqrt so the run_stats ci95 view works on any build.
+    # NULL-in/NULL-out and negative-input NULL match the SQL convention.
+    conn.create_function(
+        "SQRT", 1,
+        lambda v: math.sqrt(v) if v is not None and v >= 0 else None,
+        deterministic=True,
+    )
     conn.executescript(
         """
         CREATE TABLE IF NOT EXISTS file_index (

@@ -1,0 +1,44 @@
+"""Unified observability: journal-correlated tracing, a metrics registry,
+per-stage latency attribution, and Perfetto export (docs/OBSERVABILITY.md).
+
+- ``trace``   — span API (``span(name, **attrs)`` with trace/span/parent
+  ids, monotonic clocks) persisting through the PR 3 crash-consistent
+  ``Journal``; journal records at wired call sites gain optional
+  ``trace_id``/``span_id`` correlation fields.
+- ``metrics`` — process-wide counters/gauges/histograms (nearest-rank
+  p50/p99 — the serve bench's estimator) with atomic JSONL export and the
+  ``summary()`` the bench rows embed.
+- ``stages``  — per-stage attribution of the Blocks 1-2 forward at the
+  sentinel tap boundaries (conv1/pool1/conv2/pool2/lrn2), via timed
+  staged re-execution strictly off the timed path; the bench
+  ``breakdown`` sub-object's source.
+- ``export``  — stitch spans AND the existing journal schemas
+  (``serve_*``, ``sup_*``, ``gate_*``, ``mesh_shrink``, watchdog) into
+  one Chrome trace-event / Perfetto JSON timeline, plus the cross-run
+  BENCH_r*.json text report.
+
+CLI: ``python -m cuda_mpi_gpu_cluster_programming_tpu.observability
+export --journal <dir|file> [--out trace.json]`` and
+``... report BENCH_r*.json``.
+
+This package init re-exports only the import-light tracing/metrics
+surface (stdlib + journal — the wired subsystems pay no jax import);
+``stages`` imports jax and is imported as a submodule by its callers.
+"""
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
+from .trace import Span, Tracer, current_ids, get_tracer, set_tracer, span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "Span",
+    "Tracer",
+    "current_ids",
+    "get_tracer",
+    "set_tracer",
+    "span",
+]

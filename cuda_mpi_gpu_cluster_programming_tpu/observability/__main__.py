@@ -1,0 +1,81 @@
+"""CLI: export a Perfetto trace from journals; diff BENCH trajectories.
+
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
+        export --journal logs/serve_journal.jsonl --out logs/trace.json
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
+        report BENCH_r*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cuda_mpi_gpu_cluster_programming_tpu.observability"
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ex = sub.add_parser(
+        "export",
+        help="stitch span + journal records into a Perfetto-loadable "
+        "Chrome trace-event JSON",
+    )
+    ex.add_argument(
+        "--journal",
+        required=True,
+        help="a journal .jsonl file, or a directory whose *.jsonl files "
+        "are stitched together",
+    )
+    ex.add_argument(
+        "--out",
+        default="",
+        help="output trace path (default: <journal>.trace.json next to "
+        "the input)",
+    )
+    rp = sub.add_parser(
+        "report",
+        help="cross-run text report diffing BENCH_r*.json trajectories "
+        "(flags >10% regressions)",
+    )
+    rp.add_argument("bench", nargs="+", help="BENCH_r*.json paths")
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.cmd == "export":
+        from .export import export_trace
+
+        src = Path(args.journal)
+        if not src.exists():
+            print(f"no journal at {src}", file=sys.stderr)
+            return 2
+        out = args.out or str(
+            (src if src.is_dir() else src.with_suffix("")).with_suffix("")
+        ) + ".trace.json"
+        info = export_trace(src, out)
+        print(
+            f"Trace exported: {info['out']} events={info['events']} "
+            f"spans={info['spans']} records={info['records']}"
+        )
+        if info["spans"] == 0:
+            print(
+                "note: no span records found — the timeline is the "
+                "synthetic journal-order view (run with tracing wired, "
+                "e.g. run --serve --serve-journal / --trace, for real "
+                "timestamps)"
+            )
+        return 0
+    if args.cmd == "report":
+        from .export import bench_report
+
+        print(bench_report(args.bench))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,344 @@
+"""Stitch spans + journal records into one Chrome trace-event timeline.
+
+``python -m cuda_mpi_gpu_cluster_programming_tpu.observability export
+--journal <dir|file.jsonl>`` reads every journal in sight and writes a
+Perfetto-loadable JSON object (the Chrome trace-event format:
+``{"traceEvents": [...]}``, ``ts``/``dur`` in microseconds) so a
+``run --serve`` session, a supervised training run, or a tuning sweep
+opens in https://ui.perfetto.dev as one correlated timeline:
+
+- ``kind="span"`` records (``observability.trace``) become complete
+  ("X") events; nesting comes from their shared monotonic clock, and a
+  greedy lane assigner splits genuinely-overlapping spans (concurrent
+  queue waits) onto separate tids so Perfetto never renders a
+  mis-nested slice.
+- journal records carrying a ``span_id`` (the correlation fields the
+  wired call sites merge in) become instant events pinned to their
+  span's lane at the span's end — the ``serve_batch`` row sits ON its
+  dispatch span.
+- uncorrelated records (old journals, other processes) land on a
+  synthetic per-kind timeline ordered by append index; records with a
+  duration field (``batch_ms``/``ms``) still render as slices, so even
+  a pre-observability journal produces a readable trace.
+
+Process rows group by subsystem (span-name prefix / record kind):
+serving, supervisor, tuning, train, journal. ``M`` metadata events name
+every pid/tid.
+
+Also here: :func:`bench_report`, the cross-run text diff of
+``BENCH_r*.json`` trajectories (value / per_pass_ms / per-stage
+breakdown), flagging >10% regressions between consecutive measured
+rounds — the attribution-aware replacement for eyeballing five JSON
+blobs.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..resilience.journal import Journal, atomic_write_text
+
+# Subsystem -> pid. Span names are namespaced "<subsystem>.<what>"; journal
+# record kinds map via _KIND_PID below.
+_PIDS = {
+    "run": 1,
+    "serve": 2,
+    "sup": 3,
+    "tune": 4,
+    "train": 5,
+    "stages": 6,
+    "journal": 7,
+}
+_KIND_PID = {
+    "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
+    "serve_miss": "serve", "serve_warm": "serve", "serve_rewarm": "serve",
+    "sup_build": "sup", "sup_trip": "sup", "sup_degrade": "sup",
+    "sup_ok": "sup", "sup_warm": "sup", "sup_reshard": "sup",
+    "sup_replay": "sup", "sup_step": "sup", "mesh_shrink": "sup",
+    "gate_pass": "tune", "gate_fail": "tune",
+    "step": "train", "ckpt": "train", "rollback": "train", "resume": "train",
+    "wedge_detected": "journal", "recycle": "journal", "reprobe": "journal",
+}
+# Duration field per record kind for uncorrelated records that still carry
+# a measured wall time — they render as slices, not instants.
+_KIND_DUR_FIELD = {
+    "serve_batch": "batch_ms",
+    "serve_warm": "ms",
+    "serve_rewarm": "ms",
+    "sup_warm": "ms",
+}
+
+
+def load_records(path) -> List[dict]:
+    """All journal records under ``path``: one ``.jsonl`` file, or every
+    ``*.jsonl`` in a directory (sorted by name so replays are stable)."""
+    p = Path(path)
+    if p.is_dir():
+        records: List[dict] = []
+        for f in sorted(p.glob("*.jsonl")):
+            records.extend(Journal.load(f))
+        return records
+    return Journal.load(p)
+
+
+def _span_pid(name: str) -> int:
+    return _PIDS.get(name.split(".", 1)[0], _PIDS["run"])
+
+
+def _kind_pid(kind: str) -> int:
+    return _PIDS[_KIND_PID.get(kind, "journal")]
+
+
+class _Lanes:
+    """Greedy interval-partitioning of slices into lanes (exported tids):
+    a slice joins a lane if it nests inside the lane's innermost open
+    slice or starts after everything on the lane ended. Keeps Chrome's
+    same-tid containment invariant true by construction."""
+
+    def __init__(self):
+        self._lanes: List[List[float]] = []  # per lane: stack of open end-times
+
+    def place(self, t0: float, t1: float) -> int:
+        for i, stack in enumerate(self._lanes):
+            while stack and stack[-1] <= t0:
+                stack.pop()
+            if not stack or t1 <= stack[-1]:
+                stack.append(t1)
+                return i
+        self._lanes.append([t1])
+        return len(self._lanes) - 1
+
+
+def to_trace_events(records: List[dict]) -> dict:
+    """Stitch journal records into ``{"traceEvents": [...]}`` (µs)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    others = [r for r in records if r.get("kind") != "span"]
+
+    events: List[dict] = []
+    # (pid, track-group) -> lane allocator; exported tid = stable index of
+    # (pid, group, lane) so every lane gets its own named thread row.
+    lanes: Dict[Tuple[int, str], _Lanes] = {}
+    tid_map: Dict[Tuple[int, str, int], int] = {}
+    tid_names: Dict[Tuple[int, int], str] = {}
+
+    def _tid_for(pid: int, group: str, t0: float, t1: float) -> int:
+        lane = lanes.setdefault((pid, group), _Lanes()).place(t0, t1)
+        key = (pid, group, lane)
+        if key not in tid_map:
+            tid_map[key] = len(tid_map) + 1
+            tid_names[(pid, tid_map[key])] = (
+                f"{group}" + (f" [{lane}]" if lane else "")
+            )
+        return tid_map[key]
+
+    # Spans: sorted by start so lane assignment sees intervals in order.
+    span_loc: Dict[str, Tuple[int, int, float, float]] = {}  # sid -> pid,tid,t0,t1
+    for rec in sorted(spans, key=lambda r: (r.get("t0_ms", 0.0), -r.get("dur_ms", 0.0))):
+        t0 = float(rec.get("t0_ms", 0.0)) * 1e3  # ms -> µs
+        dur = max(1.0, float(rec.get("dur_ms", 0.0)) * 1e3)
+        pid = _span_pid(str(rec.get("name", "")))
+        group = str(rec.get("track") or f"t{rec.get('tid', 0)}")
+        tid = _tid_for(pid, group, t0, t0 + dur)
+        args = {
+            k: rec[k]
+            for k in ("trace_id", "span_id", "parent_id")
+            if rec.get(k)
+        }
+        args.update(rec.get("attrs") or {})
+        events.append(
+            {
+                "ph": "X", "name": rec.get("name", "span"), "cat": "span",
+                "ts": round(t0, 1), "dur": round(dur, 1),
+                "pid": pid, "tid": tid, "args": args,
+            }
+        )
+        if rec.get("span_id"):
+            span_loc[rec["span_id"]] = (pid, tid, t0, t0 + dur)
+
+    # Journal records: correlated ones pin to their span; the rest get a
+    # synthetic per-kind timeline that preserves append order.
+    synth_clock: Dict[str, float] = {}
+    for idx, rec in enumerate(others):
+        kind = str(rec.get("kind", "record"))
+        args = {k: v for k, v in rec.items() if k != "kind"}
+        sid = rec.get("span_id")
+        if sid and sid in span_loc:
+            pid, tid, _t0, t1 = span_loc[sid]
+            events.append(
+                {
+                    "ph": "i", "name": kind, "cat": "journal",
+                    "ts": round(t1, 1), "pid": pid, "tid": tid,
+                    "s": "t", "args": args,
+                }
+            )
+            continue
+        pid = _kind_pid(kind)
+        dur_field = _KIND_DUR_FIELD.get(kind)
+        dur_ms = rec.get(dur_field) if dur_field else None
+        t0 = max(synth_clock.get(kind, 0.0), float(idx) * 1e3)  # µs, ordered
+        if isinstance(dur_ms, (int, float)) and dur_ms > 0:
+            dur = float(dur_ms) * 1e3
+            tid = _tid_for(pid, kind, t0, t0 + dur)
+            events.append(
+                {
+                    "ph": "X", "name": kind, "cat": "journal",
+                    "ts": round(t0, 1), "dur": round(dur, 1),
+                    "pid": pid, "tid": tid, "args": args,
+                }
+            )
+            synth_clock[kind] = t0 + dur
+        else:
+            tid = _tid_for(pid, kind, t0, t0 + 1.0)
+            events.append(
+                {
+                    "ph": "i", "name": kind, "cat": "journal",
+                    "ts": round(t0, 1), "pid": pid, "tid": tid,
+                    "s": "t", "args": args,
+                }
+            )
+            synth_clock[kind] = t0 + 1.0
+
+    meta: List[dict] = []
+    for name, pid in sorted(_PIDS.items(), key=lambda kv: kv[1]):
+        if any(ev["pid"] == pid for ev in events):
+            meta.append(
+                {
+                    "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                    "args": {"name": name},
+                }
+            )
+    for (pid, tid), tname in sorted(tid_names.items()):
+        meta.append(
+            {
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": tname},
+            }
+        )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_trace(journal_path, out_path) -> dict:
+    """Load, stitch, atomically write. Returns a summary dict (the CLI
+    prints it machine-readably)."""
+    records = load_records(journal_path)
+    trace = to_trace_events(records)
+    atomic_write_text(out_path, json.dumps(trace))
+    n_spans = sum(1 for r in records if r.get("kind") == "span")
+    return {
+        "out": str(out_path),
+        "records": len(records),
+        "spans": n_spans,
+        "events": len(trace["traceEvents"]),
+    }
+
+
+# ------------------------------------------------------------ bench report
+
+
+def _bench_obj(path: Path) -> Optional[dict]:
+    """One BENCH_r*.json's measured row. The committed files are
+    driver-wrapped ({"parsed": {...}, "tail": ...}); bare row objects and
+    raw JSONL (first parseable line) are accepted too."""
+    try:
+        text = path.read_text()
+    except OSError:
+        return None
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    obj = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        else:
+            return None
+    if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]
+    return obj if isinstance(obj, dict) else None
+
+
+def _row_value(row: dict) -> Tuple[Optional[float], str]:
+    """(throughput, provenance): a fresh value, the explicitly-stale
+    committed one, or nothing measurable."""
+    v = row.get("value")
+    if isinstance(v, (int, float)) and v > 0:
+        return float(v), "fresh"
+    lg = row.get("value_last_good")
+    if isinstance(lg, (int, float)) and lg > 0:
+        return float(lg), "last_good(stale)"
+    return None, "error" if row.get("error") else "none"
+
+
+def bench_report(paths) -> str:
+    """Cross-run text report: the BENCH_r*.json trajectory with >10%
+    regressions between consecutive measured rounds flagged, plus
+    per-stage breakdown deltas where rounds carry the ``breakdown``
+    sub-object."""
+    rows: List[Tuple[str, dict]] = []
+    for p in sorted(Path(str(p)) for p in paths):
+        obj = _bench_obj(p)
+        if obj is not None:
+            rows.append((p.name, obj))
+    if not rows:
+        return "bench report: no parseable BENCH rows"
+    lines = ["bench trajectory:"]
+    prev_val: Optional[float] = None
+    prev_name = ""
+    prev_stages: Optional[Dict[str, float]] = None
+    regressions: List[str] = []
+    for name, row in rows:
+        val, prov = _row_value(row)
+        per_pass = row.get("per_pass_ms")
+        bits = [
+            f"  {name}:",
+            f"value={val:.1f} img/s" if val is not None else "value=unmeasured",
+            f"({prov})",
+        ]
+        if isinstance(per_pass, (int, float)):
+            bits.append(f"per_pass={per_pass:.3f} ms")
+        if row.get("error"):
+            bits.append(f"error={str(row['error'])[:60]!r}")
+        bd = row.get("breakdown")
+        stages = bd.get("stages") if isinstance(bd, dict) else None
+        if isinstance(stages, dict) and stages:
+            worst = max(stages, key=lambda s: stages[s])
+            bits.append(
+                f"breakdown[{len(stages)} stages, top {worst}="
+                f"{stages[worst]:.3f} ms]"
+            )
+            if prev_stages:
+                for s, ms in stages.items():
+                    p_ms = prev_stages.get(s)
+                    if (
+                        isinstance(p_ms, (int, float)) and p_ms > 0
+                        and ms > p_ms * 1.10
+                    ):
+                        regressions.append(
+                            f"  REGRESSION {name} stage {s}: "
+                            f"{p_ms:.3f} -> {ms:.3f} ms "
+                            f"(+{(ms / p_ms - 1) * 100:.0f}% vs {prev_name})"
+                        )
+            prev_stages = {
+                s: float(ms) for s, ms in stages.items()
+                if isinstance(ms, (int, float))
+            }
+        if val is not None and prev_val is not None and val < prev_val * 0.90:
+            regressions.append(
+                f"  REGRESSION {name}: {prev_val:.1f} -> {val:.1f} img/s "
+                f"(-{(1 - val / prev_val) * 100:.0f}% vs {prev_name})"
+            )
+        if val is not None:
+            prev_val, prev_name = val, name
+        lines.append(" ".join(bits))
+    if regressions:
+        lines.append("flags:")
+        lines.extend(regressions)
+    else:
+        lines.append("flags: none (no >10% regression between measured rounds)")
+    return "\n".join(lines)

@@ -105,10 +105,16 @@ class ElasticPool:
         }
         self.shrinks.append(record)
         if self.journal is not None:
+            # Optional trace correlation (observability.trace): a shrink
+            # journaled during a traced run carries the run's trace id so
+            # the exporter places it on the incident timeline.
+            from ..observability.trace import current_ids
+
             self.journal.append(
                 "mesh_shrink",
                 key=f"shrink:{before}->{self.n_alive}",
                 site=self.site,
+                **current_ids(),
                 **record,
             )
         return record

@@ -272,8 +272,14 @@ class ToleranceGate:
 
     def _journal(self, res: GateResult, key: str) -> None:
         if self.journal is not None:
+            # Optional trace correlation (observability.trace): a verdict
+            # screened inside a traced tuning sweep carries the sweep
+            # span's ids; untraced runs journal the PR 7 schema unchanged.
+            from ..observability.trace import current_ids
+
             self.journal.append(
                 "gate_pass" if res.passed else "gate_fail",
                 key=key or f"gate:{res.policy}",
+                **current_ids(),
                 **res.to_obj(),
             )

@@ -55,6 +55,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..observability.trace import current_ids, span as obs_span
 from . import chaos
 from .journal import Journal
 from .policy import DegradationExhausted, DegradedEvent
@@ -210,7 +211,10 @@ class Supervisor:
 
     def _journal(self, kind: str, key: str, **payload) -> None:
         if self.journal is not None:
-            self.journal.append(kind, key=key, **payload)
+            # Optional trace correlation (observability.trace): a trip
+            # record written inside the trip span carries that span's ids;
+            # untraced runs journal exactly the PR 5 schema.
+            self.journal.append(kind, key=key, **{**current_ids(), **payload})
 
     def _entry_mesh(self, entry: LadderEntry):
         """The surviving-device mesh this rung runs on (None for the
@@ -322,14 +326,15 @@ class Supervisor:
 
         entry = self.entry
         n = entry.n_shards if entry.strategy != "single" else 1
-        mesh = self.pool.mesh_for(max(1, n))
-        self._journal(
-            "sup_reshard",
-            key=f"reshard:{entry.key}:{self.pool.summary()}",
-            entry=entry.key,
-            devices=self.pool.n_alive,
-        )
-        return reshard_tree(tree, mesh)
+        with obs_span("sup.reshard", entry=entry.key, devices=self.pool.n_alive):
+            mesh = self.pool.mesh_for(max(1, n))
+            self._journal(
+                "sup_reshard",
+                key=f"reshard:{entry.key}:{self.pool.summary()}",
+                entry=entry.key,
+                devices=self.pool.n_alive,
+            )
+            return reshard_tree(tree, mesh)
 
     @off_timed_path
     def warm(self, params, x) -> float:
@@ -498,34 +503,20 @@ class Supervisor:
                     self.compile_ms = (time.perf_counter() - t0) * 1e3
                 self._screen(out, digests)
             except SDC as e:
-                self.trips.append(e)
-                self._journal(
-                    "sup_trip",
-                    key=f"trip:{len(self.trips)}",
-                    sdc_kind=e.kind,
-                    step=e.step,
-                    entry=entry.key,
-                    cause=str(e)[:200],
+                params = self._trip_and_recover(
+                    e, entry.key, str(e)[:200],
+                    f"SDC({e.kind}): {e.detail}"[:200], params,
                 )
-                self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
-                params = self._replay_state(params)
                 continue
             except Exception as e:  # noqa — classified below
                 if not _is_device_loss(e):
                     raise
                 kind = _loss_kind(e)
                 sdc = SDC(kind, self._step, str(e)[:200])
-                self.trips.append(sdc)
-                self._journal(
-                    "sup_trip",
-                    key=f"trip:{len(self.trips)}",
-                    sdc_kind=kind,
-                    step=self._step,
-                    entry=entry.key,
-                    cause=str(e)[:200],
+                params = self._trip_and_recover(
+                    sdc, entry.key, str(e)[:200],
+                    f"SDC({kind}): {e}"[:200], params,
                 )
-                self._advance(f"SDC({kind}): {e}"[:200], sdc)
-                params = self._replay_state(params)
                 continue
             self._journal(
                 "sup_ok",
@@ -543,14 +534,41 @@ class Supervisor:
         replay — the record that distinguishes step-level recovery from a
         checkpoint rollback in the incident trail."""
         self.replays += 1
-        tree = self.reshard(tree)
-        self._journal(
-            "sup_replay",
-            key=f"replay:{self.replays}",
-            step=self._step,
-            entry=self.entry.key,
-        )
+        with obs_span("sup.replay", step=self._step, entry=self.entry.key):
+            tree = self.reshard(tree)
+            self._journal(
+                "sup_replay",
+                key=f"replay:{self.replays}",
+                step=self._step,
+                entry=self.entry.key,
+            )
         return tree
+
+    def _trip_and_recover(self, sdc: SDC, entry_key: str, journal_cause: str,
+                          advance_cause: str, tree):
+        """One trip's full recovery under a parent ``sup.trip`` span: the
+        journaled trip record, the degrade walk (child ``sup.degrade`` —
+        the serving layer's re-warm hook and its ``serve.rewarm`` span
+        fire inside), then the live reshard + replay bookkeeping (child
+        ``sup.replay`` containing ``sup.reshard``). Returns the resharded
+        state the caller replays the batch/step with; spans are no-ops
+        when no tracer is installed. The shared tail of every trip site
+        (execute x2, supervise_step x2, trip_external)."""
+        self.trips.append(sdc)
+        with obs_span(
+            "sup.trip", kind=sdc.kind, step=sdc.step, entry=entry_key
+        ):
+            self._journal(
+                "sup_trip",
+                key=f"trip:{len(self.trips)}",
+                sdc_kind=sdc.kind,
+                step=sdc.step,
+                entry=entry_key,
+                cause=journal_cause,
+            )
+            with obs_span("sup.degrade", frm=entry_key):
+                self._advance(advance_cause, sdc)
+            return self._replay_state(tree)
 
     @off_timed_path
     def supervise_step(self, params, opt_state, x, y, step: Optional[int] = None):
@@ -600,34 +618,20 @@ class Supervisor:
                             f"{self.site}/{entry.key}: {name} = {v}",
                         )
             except SDC as e:
-                self.trips.append(e)
-                self._journal(
-                    "sup_trip",
-                    key=f"trip:{len(self.trips)}",
-                    sdc_kind=e.kind,
-                    step=e.step,
-                    entry=entry.key,
-                    cause=str(e)[:200],
+                params, opt_state = self._trip_and_recover(
+                    e, entry.key, str(e)[:200],
+                    f"SDC({e.kind}): {e.detail}"[:200], (params, opt_state),
                 )
-                self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
-                params, opt_state = self._replay_state((params, opt_state))
                 continue
             except Exception as e:  # noqa — classified below
                 if not _is_device_loss(e):
                     raise
                 kind = _loss_kind(e)
                 sdc = SDC(kind, self._step, str(e)[:200])
-                self.trips.append(sdc)
-                self._journal(
-                    "sup_trip",
-                    key=f"trip:{len(self.trips)}",
-                    sdc_kind=kind,
-                    step=self._step,
-                    entry=entry.key,
-                    cause=str(e)[:200],
+                params, opt_state = self._trip_and_recover(
+                    sdc, entry.key, str(e)[:200],
+                    f"SDC({kind}): {e}"[:200], (params, opt_state),
                 )
-                self._advance(f"SDC({kind}): {e}"[:200], sdc)
-                params, opt_state = self._replay_state((params, opt_state))
                 continue
             self._journal(
                 "sup_step",
@@ -647,17 +651,10 @@ class Supervisor:
         opt_state)`` the caller replays the batch with; raises
         :class:`DegradationExhausted` when the ladder is spent — at which
         point checkpoint rollback remains the floor."""
-        self.trips.append(e)
-        self._journal(
-            "sup_trip",
-            key=f"trip:{len(self.trips)}",
-            sdc_kind=e.kind,
-            step=e.step,
-            entry=self.entry.key,
-            cause=str(e)[:200],
+        return self._trip_and_recover(
+            e, self.entry.key, str(e)[:200],
+            f"SDC({e.kind}): {e.detail}"[:200], (params, opt_state),
         )
-        self._advance(f"SDC({e.kind}): {e.detail}"[:200], e)
-        return self._replay_state((params, opt_state))
 
     # ------------------------------------------------------------ surfacing
 

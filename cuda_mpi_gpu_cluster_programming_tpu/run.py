@@ -194,6 +194,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="with --serve: comma-separated explicit bucket "
                    "sizes (overrides the powers-of-two/TunePlan-derived "
                    "set)")
+    p.add_argument(
+        "--trace",
+        default="",
+        help="journal spans (observability.trace) to this jsonl path: "
+        "build/tune/measure phases, supervisor trip->degrade->reshard->"
+        "replay parents, per-request serve queue-wait vs dispatch; export "
+        "with 'python -m cuda_mpi_gpu_cluster_programming_tpu."
+        "observability export --journal PATH' (docs/OBSERVABILITY.md). "
+        "With --serve and --serve-journal, spans default into the serve "
+        "journal so one file carries the whole correlated timeline",
+    )
     return p
 
 
@@ -244,7 +255,18 @@ def main(argv=None) -> int:
         init_params_random,
         random_input,
     )
+    from .observability.trace import Tracer, set_tracer, span as obs_span
     from .utils.timing import amortized_stats
+
+    if args.trace:
+        # Journal-backed span tracing (docs/OBSERVABILITY.md): every
+        # wired subsystem below (tuner, supervisor, serving) records into
+        # this trail; the "Trace:" line is the machine-parseable pointer.
+        from .resilience.journal import Journal as _Journal
+
+        tracer = Tracer(journal=_Journal(args.trace))
+        set_tracer(tracer)
+        print(f"Trace: id={tracer.trace_id} journal={args.trace}")
 
     if args.list_configs:
         for c in REGISTRY.values():
@@ -326,19 +348,20 @@ def main(argv=None) -> int:
                 # the winner's policy record is persisted (docs/PRECISION.md).
                 res = None
                 try:
-                    res = autotune_precision(
-                        plan_path,
-                        model_cfg,
-                        batch=args.batch,
-                        dtypes=(run_dtype,) if pinned else DTYPES,
-                        force=args.tune_force,
-                        deadline=_Deadline.after(args.deadline_s or None),
-                        repeats=args.tune_repeats,
-                        warmup=args.tune_warmup,
-                        device_kind=device_kind,
-                        gate_journal=args.gate_journal,
-                        seed=args.seed,
-                    )
+                    with obs_span("run.tune", config=args.config, batch=args.batch):
+                        res = autotune_precision(
+                            plan_path,
+                            model_cfg,
+                            batch=args.batch,
+                            dtypes=(run_dtype,) if pinned else DTYPES,
+                            force=args.tune_force,
+                            deadline=_Deadline.after(args.deadline_s or None),
+                            repeats=args.tune_repeats,
+                            warmup=args.tune_warmup,
+                            device_kind=device_kind,
+                            gate_journal=args.gate_journal,
+                            seed=args.seed,
+                        )
                 except RuntimeError as e:
                     # Every requested dtype gate-pruned (possible only for a
                     # pinned sweep, or a broken fp32 oracle): say so and run
@@ -470,16 +493,34 @@ def main(argv=None) -> int:
             model_cfg=blocks_cfg,
         )
         server = InferenceServer(scfg, params=params, plan=plan)
-        server.start()
+        # With --trace the tracer is already installed; otherwise the
+        # serve journal doubles as the span trail, so ONE file exports
+        # into the full correlated timeline (queue-wait vs dispatch spans
+        # beside their serve_batch records — docs/OBSERVABILITY.md).
+        serve_tracer = None
+        if not args.trace and server.journal is not None:
+            serve_tracer = Tracer(journal=server.journal)
+            set_tracer(serve_tracer)
+            print(f"Trace: id={serve_tracer.trace_id} journal={scfg.journal_path}")
         try:
-            report = run_load(
-                server,
-                rate_rps=args.serve_rate,
-                duration_s=args.serve_duration,
-                seed=args.seed,
-            )
+            server.start()
+            try:
+                with obs_span(
+                    "serve.load",
+                    rate_rps=args.serve_rate,
+                    duration_s=args.serve_duration,
+                ):
+                    report = run_load(
+                        server,
+                        rate_rps=args.serve_rate,
+                        duration_s=args.serve_duration,
+                        seed=args.seed,
+                    )
+            finally:
+                server.stop()
         finally:
-            server.stop()
+            if serve_tracer is not None:
+                set_tracer(None)  # in-process callers must not leak a tracer
         print(f"Serve buckets: {','.join(str(b) for b in server.buckets)}")
         print(f"Serve load: {report.summary()}")
         print(f"Serve: {server.summary()}")
@@ -656,9 +697,15 @@ def main(argv=None) -> int:
         # Work-floor stats, not a single sample: the conv-variant A/B and
         # every harness row route through this line, so it must resolve
         # deltas smaller than the relay's ~40% single-sample noise.
-        st = amortized_stats(
-            fwd, params, x, n_small=n_small, n_large=n_small + max(1, args.repeats)
-        )
+        with obs_span(
+            "run.measure", config=exec_cfg.key, batch=args.batch,
+            dtype=run_dtype,
+        ) as _msp:
+            st = amortized_stats(
+                fwd, params, x, n_small=n_small, n_large=n_small + max(1, args.repeats)
+            )
+            if _msp is not None:
+                _msp.set(per_pass_ms=round(st.per_call_ms, 4))
         per_pass_ms = st.per_call_ms
     if args.profile:
         print(f"Profiler trace written to {args.profile}")

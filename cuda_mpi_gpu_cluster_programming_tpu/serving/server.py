@@ -37,6 +37,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.metrics import registry as metrics_registry
+from ..observability.trace import current_ids, get_tracer, span
 from ..resilience.journal import Journal
 from ..resilience.sentinel import off_timed_path
 from .batcher import AssembledBatch, Batcher, power_of_two_buckets
@@ -197,20 +199,21 @@ class InferenceServer:
         serving latency."""
         import jax
 
-        for bucket in self.buckets:
-            xb = self._warm_input(bucket)
-            if self.sup is not None:
-                ms = self.sup.warm(self._params, xb)
-            else:
-                t0 = time.perf_counter()
-                jax.block_until_ready(self._fwd(self._params, xb))
-                ms = (time.perf_counter() - t0) * 1e3
-            self.stats.warmup_compiles += 1
-            self._warmed.add(bucket)
-            self._journal(
-                "serve_warm", key=f"warm:b{bucket}", bucket=bucket,
-                ms=round(ms, 3), dtype=self.cfg.compute,
-            )
+        with span("serve.warmup", buckets=list(self.buckets)):
+            for bucket in self.buckets:
+                xb = self._warm_input(bucket)
+                if self.sup is not None:
+                    ms = self.sup.warm(self._params, xb)
+                else:
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(self._fwd(self._params, xb))
+                    ms = (time.perf_counter() - t0) * 1e3
+                self.stats.warmup_compiles += 1
+                self._warmed.add(bucket)
+                self._journal(
+                    "serve_warm", key=f"warm:b{bucket}", bucket=bucket,
+                    ms=round(ms, 3), dtype=self.cfg.compute,
+                )
 
     def _rewarm(self, entry) -> None:
         """Supervisor on_rebuild hook: a degrade landed on a fresh rung, so
@@ -221,19 +224,21 @@ class InferenceServer:
         warm compiles land on exactly the placement the replay (which the
         supervisor reshards the same way) will dispatch with — after a
         mesh shrink nothing here touches a lost device."""
-        self._warmed.clear()
-        self._params = self.sup.reshard(self._params)
-        ms = 0.0
-        for bucket in self.buckets:
-            ms += self.sup.warm(self._params, self._warm_input(bucket))
-            self.stats.warmup_compiles += 1
-            self._warmed.add(bucket)
-        self.stats.rewarm_ms += ms
-        self._journal(
-            "serve_rewarm", key=f"rewarm:{entry.key}", entry=entry.key,
-            buckets=list(self.buckets), ms=round(ms, 3),
-            devices=self.sup.pool.n_alive,
-        )
+        with span("serve.rewarm", entry=entry.key):
+            self._warmed.clear()
+            self._params = self.sup.reshard(self._params)
+            ms = 0.0
+            for bucket in self.buckets:
+                ms += self.sup.warm(self._params, self._warm_input(bucket))
+                self.stats.warmup_compiles += 1
+                self._warmed.add(bucket)
+            self.stats.rewarm_ms += ms
+            metrics_registry().counter("serve.rewarms").inc()
+            self._journal(
+                "serve_rewarm", key=f"rewarm:{entry.key}", entry=entry.key,
+                buckets=list(self.buckets), ms=round(ms, 3),
+                devices=self.sup.pool.n_alive,
+            )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -300,6 +305,7 @@ class InferenceServer:
             # the bucket discipline exists to prevent. Counted AND
             # journaled, then warmed so it can only fire once per shape.
             self.stats.cache_misses += 1
+            metrics_registry().counter("serve.cache_misses").inc()
             self._journal(
                 "serve_miss", key=f"miss:b{batch.bucket}", bucket=batch.bucket
             )
@@ -323,7 +329,12 @@ class InferenceServer:
     @off_timed_path
     def _complete(self, batch: AssembledBatch, out, batch_ms: float) -> None:
         """Slice the padded output back per request and wake the handles —
-        one host transfer per batch, contractually between timed regions."""
+        one host transfer per batch, contractually between timed regions.
+        Tracing happens HERE, after the timed region: the dispatch span is
+        emitted from its measured bounds and each request gets a
+        queue-wait span (submit -> dispatch start), so the trace carries
+        the queue-wait vs dispatch attribution without a single host sync
+        on the dispatch path."""
         arr = np.asarray(out)
         lat_ms: Dict[str, float] = {}
         for req, off in batch.offsets():
@@ -333,6 +344,39 @@ class InferenceServer:
         self.stats.n_images += batch.n_images
         self.stats.n_ok += len(batch.requests)
         self.stats.batch_ms.append(batch_ms)
+        reg = metrics_registry()
+        reg.counter("serve.ok").inc(len(batch.requests))
+        reg.counter("serve.images").inc(batch.n_images)
+        reg.histogram("serve.batch_ms").observe(batch_ms)
+        trace_fields: Dict[str, str] = {}
+        tr = get_tracer()
+        if tr is not None:
+            # Monotonic bounds reconstructed from the measured region so
+            # the span write costs the timed path nothing.
+            t1 = tr.clock()
+            t0 = t1 - batch_ms / 1e3
+            dsid = tr.emit(
+                "serve.dispatch", t0, t1, track="dispatch",
+                bucket=batch.bucket, seq=batch.seq,
+                n_requests=len(batch.requests),
+                entry=(
+                    self.sup.entry.key if self.sup is not None
+                    else self.cfg.config
+                ),
+            )
+            trace_fields = {"trace_id": tr.trace_id, "span_id": dsid}
+            for req in batch.requests:
+                wait_ms = (t0 - req.handle.submitted_at) * 1e3
+                reg.histogram("serve.queue_wait_ms").observe(max(0.0, wait_ms))
+                tr.emit(
+                    "serve.queue_wait", req.handle.submitted_at, t0,
+                    parent_id="", track="queue", rid=req.rid,
+                )
+        else:
+            for req in batch.requests:
+                reg.histogram("serve.queue_wait_ms").observe(
+                    max(0.0, req.handle.latency_ms - batch_ms)
+                )
         self._journal(
             "serve_batch",
             key=f"batch:{batch.seq}",
@@ -343,11 +387,13 @@ class InferenceServer:
             batch_ms=round(batch_ms, 3),
             req_lat_ms=lat_ms,
             entry=self.sup.entry.key if self.sup is not None else self.cfg.config,
+            **trace_fields,
         )
 
     @off_timed_path
     def _record_shed(self, shed: List[Request]) -> None:
         self.stats.n_shed += len(shed)
+        metrics_registry().counter("serve.shed").inc(len(shed))
         for req in shed:
             self._journal(
                 "serve_shed", key=f"shed:{req.rid}", rid=req.rid,
@@ -360,6 +406,7 @@ class InferenceServer:
         for req in batch.requests:
             req.handle._complete(FAILED, error=cause)
         self.stats.n_failed += len(batch.requests)
+        metrics_registry().counter("serve.failed").inc(len(batch.requests))
         self._journal(
             "serve_fail",
             key=f"fail:{batch.seq}",
@@ -388,7 +435,10 @@ class InferenceServer:
 
     def _journal(self, kind: str, key: str, **payload) -> None:
         if self.journal is not None:
-            self.journal.append(kind, key=key, **payload)
+            # Correlation fields ride along when a tracer is active (and a
+            # call site's explicit span_id wins over the ambient one);
+            # schemas keep their shape for pre-observability tooling.
+            self.journal.append(kind, key=key, **{**current_ids(), **payload})
 
     def summary(self) -> str:
         """One machine-parseable line ('Serve: ...' — run CLI contract)."""

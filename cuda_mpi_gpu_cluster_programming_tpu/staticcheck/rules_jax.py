@@ -29,6 +29,16 @@ gate that cries wolf gets ``# noqa``'d into uselessness.
                          runs between timed regions, not inside them; the
                          decorator is the statically-checkable form of that
                          contract (same review bar as a # noqa).
+  span-write-in-timed-region — span/metric persistence (tracer.emit /
+                         with ...span(...) / histogram.observe /
+                         counter.inc / a tracer-owned journal append)
+                         inside a TIMED loop in the hot-loop scope (now
+                         including observability/): spans persist with an
+                         fsync'd journal append — measure first, persist
+                         from an @off_timed_path completion helper
+                         (Tracer.emit takes explicit bounds for exactly
+                         this). Same exemption mechanics as
+                         host-sync-in-hot-loop.
   key-reuse            — the same PRNG key expression consumed by two
                          jax.random draws with no intervening split/fold_in
                          rebinding (same scope), or a loop-invariant key
@@ -272,11 +282,21 @@ class UnreducedContractionRule(Rule):
 # loops: a host sync per dispatched batch is a latency tax on every
 # request, so serving/{server,loadgen,batcher,queue}.py live under the
 # same rule (journal writes and result slicing are exempted via the same
-# @off_timed_path contract the supervisor's screening uses).
+# @off_timed_path contract the supervisor's screening uses). The
+# observability subsystem (trace/metrics/stages/export) lives here too —
+# an instrumentation layer that syncs inside the loops it instruments
+# would corrupt every number it reports.
 _HOT_LOOP_FILES = {
     "bench.py", "harness.py", "training.py", "run.py", "supervisor.py",
     "server.py", "loadgen.py", "batcher.py", "queue.py",
 }
+_HOT_LOOP_DIRS = {"observability"}
+
+
+def _in_hot_loop_scope(path: Path) -> bool:
+    return path.name in _HOT_LOOP_FILES or bool(
+        _HOT_LOOP_DIRS & set(path.parts[:-1])
+    )
 _TIME_CALLS = {"monotonic", "perf_counter", "time", "process_time"}
 _OFF_TIMED_PATH_DECORATOR = "off_timed_path"
 
@@ -327,7 +347,7 @@ class HostSyncInHotLoopRule(Rule):
     code = "host-sync-in-hot-loop"
 
     def applies(self, path: Path) -> bool:
-        return path.name in _HOT_LOOP_FILES
+        return _in_hot_loop_scope(path)
 
     def check(self, ctx: FileContext) -> List[Finding]:
         out = []
@@ -383,6 +403,96 @@ class HostSyncInHotLoopRule(Rule):
             # float() is only a sync when applied to a device value; outside
             # a timed loop the FP rate (str/row parsing) swamps the signal.
             return "float(...)"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# span-write-in-timed-region
+
+
+# Persistence calls of the observability layer: span emission, metric
+# observation, tracer/metric journal appends. Each one is an fsync (span
+# journal) or a lock acquisition (registry) — file-system latency inside
+# the region being measured corrupts the measurement it serves.
+_SPAN_WRITE_ATTRS = {"emit", "observe", "inc", "span"}
+_TRACERISH = ("tracer", "metric", "registry", "span")
+
+
+def _receiver_name(func: ast.expr) -> str:
+    """Terminal variable name a method call dispatches on: ``tracer`` for
+    ``tracer.emit``, ``journal`` for ``self.journal.append``."""
+    v = func.value if isinstance(func, ast.Attribute) else None
+    while isinstance(v, ast.Attribute):
+        if isinstance(v.value, ast.Name) and v.value.id == "self":
+            return v.attr
+        v = v.value
+    return v.id if isinstance(v, ast.Name) else ""
+
+
+@register
+class SpanWriteInTimedRegionRule(Rule):
+    """Span/metric persistence inside a TIMED region (a for/while whose
+    body reads the clock): ``tracer.emit``/``.span``, ``histogram.
+    observe``, ``counter.inc``, or a journal ``append`` on a tracer-owned
+    journal. The observability contract is measure-first, persist-after —
+    the serving dispatch loop emits its spans from the ``@off_timed_path``
+    completion helper, and anything else must too (or carry a reviewed
+    ``# noqa: span-write-in-timed-region``)."""
+
+    code = "span-write-in-timed-region"
+
+    def applies(self, path: Path) -> bool:
+        return _in_hot_loop_scope(path)
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out = []
+        exempt = _off_timed_path_spans(ctx.tree)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            if not _loop_is_timed(loop):
+                continue
+            for node in _iter_loop_body(loop):
+                what = self._write_kind(node)
+                if what is None:
+                    continue
+                if any(a <= node.lineno <= b for a, b in exempt):
+                    continue  # @off_timed_path: persistence by contract
+                out.append(
+                    self.finding(
+                        ctx, node.lineno,
+                        f"{what} inside a timed region — spans/metrics "
+                        "persist with an fsync'd journal append or a lock; "
+                        "measure first and persist from an @off_timed_path "
+                        "completion helper (Tracer.emit takes explicit "
+                        "bounds for exactly this), or # noqa: "
+                        "span-write-in-timed-region with a reason",
+                        span=(node.lineno, getattr(node, "end_lineno", node.lineno)),
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _write_kind(node: ast.AST):
+        # with tracer.span(...)/with span(...): the context-manager form.
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                c = item.context_expr
+                if isinstance(c, ast.Call):
+                    f = c.func
+                    if isinstance(f, ast.Name) and f.id in ("span", "obs_span"):
+                        return f"{f.id}(...)"
+                    if isinstance(f, ast.Attribute) and f.attr == "span":
+                        return f"{_receiver_name(f) or '<expr>'}.span(...)"
+            return None
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+            return None
+        attr = node.func.attr
+        recv = _receiver_name(node.func)
+        if attr in ("emit", "observe", "inc"):
+            return f"{recv or '<expr>'}.{attr}(...)"
+        if attr == "append" and any(t in recv.lower() for t in _TRACERISH):
+            return f"{recv}.append(...)"
         return None
 
 

@@ -161,6 +161,7 @@ def _run_resilient_loop(
     """
     import jax
 
+    from .observability.trace import current_ids, span as obs_span
     from .resilience import chaos
     from .resilience.policy import DegradationExhausted
     from .resilience.sentinel import SDC
@@ -177,7 +178,10 @@ def _run_resilient_loop(
         nonlocal rollbacks, student, opt_state, i
         rollbacks += 1
         flog.record("retry", cause=cause[:160])
-        jr.append("rollback", key=f"rollback:{i + 1}", step=i + 1, cause=cause[:200])
+        jr.append(
+            "rollback", key=f"rollback:{i + 1}", step=i + 1, cause=cause[:200],
+            **current_ids(),
+        )
         print(
             f"{cause} -> rollback to last-good step {last_good_step} "
             f"(rollback {rollbacks}/{args.max_rollbacks})",
@@ -199,10 +203,14 @@ def _run_resilient_loop(
         x = jax.device_put(get_batch(i))
         y = teacher_fwd(teacher, x)
         try:
-            if sup is not None:
-                out = sup.supervise_step(student, opt_state, x, y, step=i)
-            else:
-                out = step_fn(student, opt_state, x, y)
+            # One span per training step (no-op untraced): the supervisor's
+            # trip->degrade->reshard->replay spans nest under it, so an
+            # incident reads as one tree in the exported timeline.
+            with obs_span("train.step", step=i + 1):
+                if sup is not None:
+                    out = sup.supervise_step(student, opt_state, x, y, step=i)
+                else:
+                    out = step_fn(student, opt_state, x, y)
         except DegradationExhausted as e:
             # Ladder spent mid-step: the checkpoint rollback is the floor.
             rc = _rollback(f"elastic ladder exhausted: {str(e)[:120]}")
@@ -259,11 +267,11 @@ def _run_resilient_loop(
         last = loss
         steps_run += 1
         print(f"Step {i + 1}/{args.steps}: loss = {loss:.6f}")
-        jr.append("step", key=f"step:{i + 1}", step=i + 1, loss=loss)
+        jr.append("step", key=f"step:{i + 1}", step=i + 1, loss=loss, **current_ids())
         i += 1
         if i % args.checkpoint_every == 0 or i == args.steps:
             save_state(student, opt_state, i)
-            jr.append("ckpt", key=f"ckpt:{i}", step=i)
+            jr.append("ckpt", key=f"ckpt:{i}", step=i, **current_ids())
             last_good_step = i
             rollbacks = 0  # progress made: reset the consecutive-trip budget
     flog.record("ok")
@@ -444,7 +452,17 @@ def main(argv=None) -> int:
             return native.fill_batch(shape, "uniform", native.batch_seed(args.seed, k))
 
         sup = None
+        train_tracer = None
         if args.supervise_steps:
+            # Spans ride the SAME work-dir journal the step/ckpt records
+            # use, so one export covers the whole supervised run
+            # (docs/OBSERVABILITY.md); step/rollback/ckpt records gain the
+            # trace id, supervisor trips their span ids.
+            from .observability.trace import Tracer, set_tracer
+
+            train_tracer = Tracer(journal=jr)
+            set_tracer(train_tracer)
+            print(f"Trace: id={train_tracer.trace_id} journal={jr.path}")
             from .resilience.supervisor import Supervisor, train_ladder
             from .training import make_elastic_step_builder
 
@@ -463,11 +481,17 @@ def main(argv=None) -> int:
                 site="train",
             )
 
-        rc = _run_resilient_loop(
-            args, jr, save_state, load_state, start_step, get_batch, teacher_fwd,
-            teacher, step_fn, student, opt_state, sentinel, mesh,
-            FaultLog(site="train-sentinel"), sup=sup,
-        )
+        try:
+            rc = _run_resilient_loop(
+                args, jr, save_state, load_state, start_step, get_batch, teacher_fwd,
+                teacher, step_fn, student, opt_state, sentinel, mesh,
+                FaultLog(site="train-sentinel"), sup=sup,
+            )
+        finally:
+            if train_tracer is not None:
+                from .observability.trace import set_tracer
+
+                set_tracer(None)  # in-process callers must not leak a tracer
         if isinstance(rc, int):
             return rc
         first, last, steps_run = rc

@@ -22,6 +22,7 @@ import functools
 from pathlib import Path
 from typing import Callable, Dict, Optional, Tuple
 
+from ..observability.trace import span as obs_span
 from ..ops.pallas_kernels import KernelVariants
 from ..resilience import chaos
 from ..resilience.policy import Deadline
@@ -159,7 +160,15 @@ def tune_layer(
         try:
             if ch is not None:
                 ch.maybe_raise("kernel_compile", f"tune {g.name} [{v.label()}]")
-            ms, ci, n = timer(g, v, dtype, batch, repeats, warmup)
+            # One span per timed candidate (observability.trace; no-op
+            # untraced): the sweep's wall time becomes attributable
+            # per-candidate in the exported timeline.
+            with obs_span(
+                "tune.candidate", layer=g.name, variant=v.label(), dtype=dtype
+            ) as sp:
+                ms, ci, n = timer(g, v, dtype, batch, repeats, warmup)
+                if sp is not None:
+                    sp.set(ms=round(ms, 4), ci95_ms=round(ci, 4), n=n)
             timed.append((ms, ci, n, v))
             log(f"tune {g.name}: {v.label()} -> {ms:.3f} ms (ci95 {ci:.3f}, n={n})")
         except Exception as e:  # noqa — a broken candidate must not kill the sweep
@@ -236,10 +245,11 @@ def autotune_model(
             }
             notes.append(f"{name}: deadline expired before sweep")
             continue
-        winner, lstats, degraded = tune_layer(
-            g, dtype=dtype, batch=batch, deadline=deadline,
-            repeats=repeats, warmup=warmup, timer=timer, log=log,
-        )
+        with obs_span("tune.layer", layer=name, dtype=dtype, batch=batch):
+            winner, lstats, degraded = tune_layer(
+                g, dtype=dtype, batch=batch, deadline=deadline,
+                repeats=repeats, warmup=warmup, timer=timer, log=log,
+            )
         layers.append((name, winner))
         stats[name] = lstats
         if degraded:
@@ -441,10 +451,11 @@ def autotune_precision(
     gates: Dict[str, dict] = {}
     inner_cached: list = []
     for dt in dtypes:
-        res = gate.screen(
-            dt, params, x, model_cfg,
-            key=f"gate:{dt}|{device_kind}|{sk}|b{batch}",
-        )
+        with obs_span("tune.gate", dtype=dt):
+            res = gate.screen(
+                dt, params, x, model_cfg,
+                key=f"gate:{dt}|{device_kind}|{sk}|b{batch}",
+            )
         gates[dt] = res.to_obj()
         if not res.passed:
             # fp32 failing means the ORACLE CHAIN is broken (preflight or

@@ -110,6 +110,14 @@ sys.exit(0 if ok else 1)"; then
 else
     say "SERVE SMOKE FAILED — continuous-batching path broken; fix before serving this window (journal: logs/serve_smoke_${FTS}.jsonl)"
 fi
+# Perfetto trace artifact for the serve drill (docs/OBSERVABILITY.md): the
+# serve journal carries dispatch/queue-wait spans beside its serve_batch
+# records, so the export is one command and the timeline lands next to the
+# other round evidence (open at https://ui.perfetto.dev).
+timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    export --journal "logs/serve_smoke_${FTS}.jsonl" \
+    --out "logs/trace_serve_${FTS}.json" 2>&1 | tee -a "$LOG" \
+    || say "serve trace export failed — see $LOG"
 
 # 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
 # timing once turned a ~30 s case into a 600 s timeout. If a test suite is
@@ -231,12 +239,19 @@ say "kernel autotune + tuned headline (dtype-swept plan cached in perf/tune_plan
 # expiry degrades to the default plan (visibly) instead of eating the
 # window. bf16 rows are gate-checked above: a failed gate skips the bf16
 # capture entirely rather than publishing an unverified row.
+# --trace journals per-candidate sweep spans + the measure phase; the
+# export below turns the tuned headline run into a Perfetto timeline
+# artifact (where the sweep's wall time went, per candidate).
 timeout 3600 python -m cuda_mpi_gpu_cluster_programming_tpu.run \
     --config v3_pallas --batch 128 --repeats 100 \
     --tune --plan perf/tune_plan.json --deadline-s 2700 \
-    --gate-journal "$GATE_JOURNAL" 2>&1 \
-    | grep -E "Tune plan|Precision|Gate pruned|tune dtype|completed in|DEGRADED" \
+    --gate-journal "$GATE_JOURNAL" --trace "logs/tuned_trace_${FTS}.jsonl" 2>&1 \
+    | grep -E "Tune plan|Precision|Gate pruned|tune dtype|completed in|DEGRADED|Trace:" \
     | sed "s/^/tuned sweep /" | tee -a "$LOG"
+timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    export --journal "logs/tuned_trace_${FTS}.jsonl" \
+    --out "logs/trace_tuned_${FTS}.json" 2>&1 | tee -a "$LOG" \
+    || say "tuned trace export failed — see $LOG"
 for comp in bf16 fp32; do
     if [ "$comp" = bf16 ] && [ "$GATE_BF16_OK" != 1 ]; then
         say "tuned bf16 row SKIPPED (gate failed; fp32 reference floor still captured)"

@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
@@ -131,6 +133,14 @@ def test_bench_end_to_end_cpu_schema():
     # (the sub-object is a TPU-capability statement).
     assert out["mfu"] is None
     assert "bf16" not in out
+    # ISSUE 9: measure rows carry the per-stage breakdown at the sentinel
+    # tap boundaries, and the stage sum holds the sums-to-total contract
+    # against the independently measured per_pass_ms (15% CPU-mesh budget).
+    bd = out["breakdown"]
+    assert set(bd["stages"]) == {"conv1", "pool1", "conv2", "pool2", "lrn2"}
+    assert all(ms >= 0 for ms in bd["stages"].values())
+    assert bd["stage_sum_ms"] == pytest.approx(out["per_pass_ms"], rel=0.15)
+    assert bd["method"] == "prefix-diff" and bd["batch"] == 4
 
 
 def test_bench_multi_config_sweep_one_row_per_config():
@@ -156,6 +166,11 @@ def test_bench_multi_config_sweep_one_row_per_config():
         assert r["metric"] == bench.METRIC
         assert r["value"] > 0 and r["batch"] == 2
         assert r["timing_n"] >= 1
+    # ISSUE 9: the reference tier attributes for real; the Pallas tier on
+    # CPU degrades to a visible note (interpret-mode staging would
+    # attribute tracing overhead, not kernels).
+    assert rows[0]["breakdown"]["stage_sum_ms"] > 0
+    assert "skipped" in rows[1]["breakdown"]
 
 
 def test_error_rows_carry_their_config(tmp_path, monkeypatch):

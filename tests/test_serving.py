@@ -496,3 +496,25 @@ def test_bench_serve_mode_cpu_smoke(tmp_path):
     assert shrink["cache_misses_post_rewarm"] == 0
     # the journal backs the reported percentiles
     assert len(request_latencies_from_journal(jpath)) == row["n_ok"]
+    # ISSUE 9 CI satellite: serve rows carry a NON-EMPTY per-stage
+    # breakdown (sentinel tap boundaries) alongside the zero-cache-miss
+    # assertion above, the process metrics summary, and the trace id the
+    # journal's spans correlate on.
+    bd = row["breakdown"]
+    assert set(bd["stages"]) == {"conv1", "pool1", "conv2", "pool2", "lrn2"}
+    assert bd["stage_sum_ms"] > 0
+    metrics = row["metrics"]
+    assert metrics["serve.ok"] == row["n_ok"]
+    assert metrics["serve.batch_ms"]["count"] >= 1
+    assert metrics["serve.batch_ms"]["p50"] > 0
+    assert row["trace_id"]
+    # the serve journal doubles as the span trail: dispatch + queue-wait
+    # spans landed beside their serve_batch records, exportable as one
+    # Perfetto timeline
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+
+    recs = Journal.load(jpath)
+    span_names = {r["name"] for r in recs if r["kind"] == "span"}
+    assert {"serve.dispatch", "serve.queue_wait", "serve.warmup"} <= span_names
+    batches = [r for r in recs if r["kind"] == "serve_batch"]
+    assert batches and all(r.get("trace_id") == row["trace_id"] for r in batches)

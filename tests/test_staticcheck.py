@@ -696,7 +696,109 @@ def test_cli_list_rules_has_all_new_codes():
     for code in (
         "collective-axis", "unreduced-contraction", "host-sync-in-hot-loop",
         "key-reuse", "jit-in-loop", "check-vma-disabled", "implicit-upcast",
-        "stale-device-set",
+        "stale-device-set", "span-write-in-timed-region",
         "raw-subprocess", "atomic-write", "variant-env", "deprecated",
     ):
         assert code in proc.stdout, code
+
+
+# ---------------------------------------------------------------------------
+# span-write-in-timed-region (ISSUE 9) + observability host-sync scope
+
+
+_SPAN_WRITE_SRC = (
+    "import time\n"
+    "def loop(tracer, reg, batches, fwd):\n"
+    "    for b in batches:\n"
+    "        t0 = time.perf_counter()\n"
+    "        out = fwd(b)\n"
+    "        ms = (time.perf_counter() - t0) * 1e3\n"
+    "        reg.histogram('batch_ms').observe(ms)\n"  # line 7: flagged
+    "    return out\n"
+)
+
+
+def test_span_write_in_timed_region_triggers(tmp_path):
+    """A metric observation inside a timed dispatch loop is flagged in a
+    hot-loop-scoped file (here: a serving-named fixture)."""
+    p = tmp_path / "server.py"
+    p.write_text(_SPAN_WRITE_SRC)
+    found = findings_for(p, "span-write-in-timed-region")
+    assert len(found) == 1 and found[0].line == 7
+    assert "off_timed_path" in found[0].message
+
+
+def test_span_write_covers_emit_and_span_ctx(tmp_path):
+    p = tmp_path / "loadgen.py"
+    p.write_text(
+        "import time\n"
+        "from cuda_mpi_gpu_cluster_programming_tpu.observability.trace import span\n"
+        "def loop(tracer, xs):\n"
+        "    while xs:\n"
+        "        t0 = time.monotonic()\n"
+        "        with span('dispatch'):\n"      # line 6: flagged (ctx form)
+        "            xs.pop()\n"
+        "        tracer.emit('x', t0, time.monotonic())\n"  # line 8: flagged
+    )
+    found = findings_for(p, "span-write-in-timed-region")
+    assert sorted(f.line for f in found) == [6, 8]
+
+
+def test_span_write_untimed_loop_and_off_timed_path_exempt(tmp_path):
+    """Only TIMED regions are in scope, and @off_timed_path persistence
+    helpers are exempt by contract — the serving completion path."""
+    p = tmp_path / "server.py"
+    p.write_text(
+        "import time\n"
+        "def off_timed_path(fn):\n"
+        "    return fn\n"
+        "def drain(reg, batches):\n"
+        "    for b in batches:\n"          # no clock read: not a timed region
+        "        reg.counter('ok').inc()\n"
+        "@off_timed_path\n"
+        "def complete(tracer, reg, batches):\n"
+        "    for b in batches:\n"
+        "        t0 = time.perf_counter()\n"
+        "        reg.histogram('ms').observe(time.perf_counter() - t0)\n"
+        "        tracer.emit('dispatch', t0, time.perf_counter())\n"
+    )
+    assert findings_for(p, "span-write-in-timed-region") == []
+
+
+def test_span_write_noqa(tmp_path):
+    p = tmp_path / "server.py"
+    src = _SPAN_WRITE_SRC.replace(
+        ".observe(ms)\n", ".observe(ms)  # noqa: span-write-in-timed-region\n"
+    )
+    p.write_text(src)
+    assert findings_for(p, "span-write-in-timed-region") == []
+
+
+def test_observability_scope_and_shipped_modules_clean():
+    """ISSUE 9 satellite: observability/ joins the host-sync scope (an
+    instrumentation layer that syncs inside the loops it instruments
+    corrupts what it reports), the new span-write rule covers it, and the
+    shipped modules are clean under both rules."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        HostSyncInHotLoopRule,
+        SpanWriteInTimedRegionRule,
+    )
+
+    obs = "cuda_mpi_gpu_cluster_programming_tpu/observability"
+    for rule in (HostSyncInHotLoopRule(), SpanWriteInTimedRegionRule()):
+        assert rule.applies(Path(f"{obs}/trace.py"))
+        assert rule.applies(Path("cuda_mpi_gpu_cluster_programming_tpu/run.py"))
+        assert not rule.applies(
+            Path("cuda_mpi_gpu_cluster_programming_tpu/analysis.py")
+        )
+    for mod in ("trace.py", "metrics.py", "stages.py", "export.py"):
+        assert findings_for(ROOT / obs / mod, "host-sync-in-hot-loop") == []
+        assert findings_for(ROOT / obs / mod, "span-write-in-timed-region") == []
+    # the wired hot paths stay clean too (persistence lives in
+    # @off_timed_path helpers by construction)
+    for rel in (
+        "cuda_mpi_gpu_cluster_programming_tpu/serving/server.py",
+        "cuda_mpi_gpu_cluster_programming_tpu/resilience/supervisor.py",
+        "bench.py",
+    ):
+        assert findings_for(ROOT / rel, "span-write-in-timed-region") == []
