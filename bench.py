@@ -521,7 +521,121 @@ def _serve_drill(model_cfg) -> dict:
         }
     except Exception as e:  # evidence, not the headline — degrade visibly
         drill["mesh_shrink"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # Grow-back drill (ISSUE 10): the closed loop — shrink, heal, sit out
+    # probation, promote — with the throughput-recovery verdict the
+    # BENCH_r* trajectory compares across rounds.
+    try:
+        drill["mesh_grow"] = _serve_grow_drill(model_cfg)
+    except Exception as e:
+        drill["mesh_grow"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     return drill
+
+
+def _serve_grow_drill(model_cfg, journal_path: str = "") -> dict:
+    """Seeded grow-back drill through the serving stack (docs/RESILIENCE.md
+    "Grow-back & hysteresis"): measure a pre-loss rate, lose a seeded
+    device mid-load (degrade + replay), heal it, drain enough clean batches
+    for probation to pass, and verify the dispatch loop PROMOTES back to
+    the original rung — throughput recovered to within tolerance of the
+    pre-loss rate, recovery_ms attributed, zero post-promotion cache
+    misses, completed == offered. Also callable standalone (scripts/
+    on_heal.sh gates on it with a journal before chip time)."""
+    import time as _time
+
+    import numpy as np
+
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.queue import OK
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.server import (
+        InferenceServer,
+        ServeConfig,
+    )
+
+    scfg = ServeConfig(
+        config=os.environ.get("BENCH_SERVE_DRILL_CONFIG", "v2.2_sharded"),
+        n_shards=int(os.environ.get("BENCH_SERVE_DRILL_SHARDS", "2")),
+        max_batch=4,
+        supervise=True,
+        model_cfg=model_cfg,
+        journal_path=journal_path,
+    )
+    m = model_cfg
+    wave_n = 6
+
+    def _wave(server):
+        imgs = [
+            np.full((1, m.in_height, m.in_width, m.in_channels),
+                    1.0 + 0.01 * i, np.float32)
+            for i in range(wave_n)
+        ]
+        handles = [server.submit(im) for im in imgs]
+        n0 = len(server.stats.batch_ms)
+        server.run_until_drained()
+        wave_ms = sum(server.stats.batch_ms[n0:])
+        rate = (wave_n / (wave_ms / 1e3)) if wave_ms > 0 else 0.0
+        return handles, rate
+
+    offered = 0
+    completed = 0
+    srv = InferenceServer(scfg)
+    # Phase A — pre-loss baseline rate at the full rung, chaos off.
+    hs, pre_rate = _wave(srv)
+    offered += len(hs)
+    completed += sum(1 for h in hs if h.status == OK)
+    # Phase B — seeded loss mid-load: trip -> degrade -> replay.
+    saved = os.environ.get(chaos.CHAOS_ENV)
+    os.environ[chaos.CHAOS_ENV] = os.environ.get(
+        "BENCH_SERVE_GROW_CHAOS", "seed=3,mesh_shrink=1"
+    )
+    chaos.reset()
+    try:
+        hs, _ = _wave(srv)
+    finally:
+        if saved is None:
+            os.environ.pop(chaos.CHAOS_ENV, None)
+        else:
+            os.environ[chaos.CHAOS_ENV] = saved
+        chaos.reset()
+    offered += len(hs)
+    completed += sum(1 for h in hs if h.status == OK)
+    sup = srv.sup
+    degraded_entry = sup.entry.key
+    lost = sup.pool.recently_lost(sup.pool.n_lost)
+    # Phase C — heal, then drain clean waves until probation passes and the
+    # dispatch loop promotes (bounded: probation N clean batches).
+    t_heal = _time.perf_counter()
+    sup.pool.heal(lost, cause="drill:mesh_grow")
+    recovery_ms = None
+    for _ in range(sup.pool.probation_steps + 3):
+        hs, _ = _wave(srv)
+        offered += len(hs)
+        completed += sum(1 for h in hs if h.status == OK)
+        if sup.promotions:
+            recovery_ms = (_time.perf_counter() - t_heal) * 1e3
+            break
+    # Phase D — post-promotion rate at the recovered rung.
+    misses_before_post = srv.stats.cache_misses
+    hs, post_rate = _wave(srv)
+    offered += len(hs)
+    completed += sum(1 for h in hs if h.status == OK)
+    tol = float(os.environ.get("BENCH_SERVE_GROW_TOL", "0.5"))
+    return {
+        "n_requests": offered,
+        "completed": completed,
+        "devices_lost": lost,
+        "degraded_entry": degraded_entry,
+        "promoted_entry": sup.entry.key,
+        "promotions": sup.promotions,
+        "trips": [t.kind for t in sup.trips],
+        "pre_img_s": round(pre_rate, 1),
+        "post_img_s": round(post_rate, 1),
+        "recovered": bool(
+            sup.promotions and post_rate >= pre_rate * (1.0 - tol)
+        ),
+        "recovery_ms": round(recovery_ms, 3) if recovery_ms is not None else None,
+        "cache_misses_post_promote": srv.stats.cache_misses - misses_before_post,
+        "cache_misses_total": srv.stats.cache_misses,
+    }
 
 
 def _plan_policy_for(model_cfg) -> str:

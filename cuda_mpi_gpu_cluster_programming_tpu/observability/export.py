@@ -57,6 +57,12 @@ _KIND_PID = {
     "sup_build": "sup", "sup_trip": "sup", "sup_degrade": "sup",
     "sup_ok": "sup", "sup_warm": "sup", "sup_reshard": "sup",
     "sup_replay": "sup", "sup_step": "sup", "mesh_shrink": "sup",
+    # Grow-back records (ISSUE 10, docs/RESILIENCE.md "Grow-back &
+    # hysteresis") land on the same incident lane as the trip/degrade
+    # family, so one timeline reads trip -> degrade -> heal -> probation ->
+    # promote end to end. Old journals without them export unchanged.
+    "mesh_probation": "sup", "mesh_quarantine": "sup",
+    "sup_promote": "sup", "sup_promote_refused": "sup",
     "gate_pass": "tune", "gate_fail": "tune",
     "step": "train", "ckpt": "train", "rollback": "train", "resume": "train",
     "wedge_detected": "journal", "recycle": "journal", "reprobe": "journal",
@@ -68,6 +74,11 @@ _KIND_DUR_FIELD = {
     "serve_warm": "ms",
     "serve_rewarm": "ms",
     "sup_warm": "ms",
+    # A committed promotion carries its wall ms (spot-check + reshard +
+    # re-warm); a probation "pass" record carries the ms the device waited
+    # — both render as slices on the incident lane.
+    "sup_promote": "ms",
+    "mesh_probation": "ms",
 }
 
 

@@ -25,12 +25,30 @@ This module makes the shrink real:
 Every shrink is journaled (``mesh_shrink`` records) and drillable on CPU:
 ``CHAOS_SPEC="seed=3,mesh_shrink=k"`` drops k seeded devices mid-run
 (docs/RESILIENCE.md "True elastic meshes").
+
+Since PR 10 the shrink has an inverse — grow-back with anti-flap
+hysteresis (docs/RESILIENCE.md "Grow-back & hysteresis"):
+
+- :meth:`ElasticPool.heal` / :meth:`ElasticPool.rejoin_check` move a lost
+  device back toward eligibility, but ONLY after it reappears in a fresh
+  ``jax.devices()`` re-query — the stale-device-set discipline applies to
+  rejoin exactly as it does to shrink (an id healed on the operator's say-so
+  that the runtime cannot actually see would put a ghost in the next mesh).
+- A rejoined device does NOT immediately count toward :meth:`mesh_for`: it
+  sits in a journaled probation state (``mesh_probation`` records,
+  ``probation_steps`` clean supervised steps/batches ticked via
+  :meth:`note_clean_batch`) before graduating back into ``alive()``.
+- A device that completes ``quarantine_flaps`` lose→heal cycles within
+  ``flap_window`` clean-step ticks is quarantined attributably
+  (``mesh_quarantine`` record) instead of oscillating the mesh — the
+  supervisor's promotion path never sees it again.
 """
 
 from __future__ import annotations
 
 import random
-from typing import Iterable, List, Optional, Set, Tuple
+import time
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -50,17 +68,42 @@ class ElasticPool:
     truth of that moment.
     """
 
-    def __init__(self, journal=None, site: str = "elastic"):
+    def __init__(
+        self,
+        journal=None,
+        site: str = "elastic",
+        probation_steps: int = 2,
+        quarantine_flaps: int = 3,
+        flap_window: int = 64,
+    ):
         self.journal = journal
         self.site = site
+        # Anti-flap hysteresis knobs (docs/RESILIENCE.md "Grow-back &
+        # hysteresis"): N clean supervised steps/batches a rejoined device
+        # waits in probation, K lose->heal cycles within `flap_window`
+        # clean-step ticks that quarantine it.
+        self.probation_steps = max(0, int(probation_steps))
+        self.quarantine_flaps = max(1, int(quarantine_flaps))
+        self.flap_window = max(1, int(flap_window))
         self._lost_ids: Set[int] = set()
+        self._lost_order: List[int] = []  # loss recency (most recent last)
+        self._probation: Dict[int, int] = {}  # id -> clean steps remaining
+        self._probation_t0: Dict[int, float] = {}  # id -> monotonic entry time
+        self._quarantined: Set[int] = set()
+        self._heal_pending: Set[int] = set()  # healed ids not yet re-enumerated
+        self._flaps: Dict[int, List[int]] = {}  # id -> clock of each heal
+        self._clock = 0  # clean-batch ticks; the flap window's time base
         self.shrinks: List[dict] = []  # one record per lose() call
 
     # ------------------------------------------------------------ queries
 
     def alive(self) -> List[jax.Device]:
-        """Surviving devices, re-queried from the runtime NOW."""
-        return [d for d in jax.devices() if d.id not in self._lost_ids]
+        """ELIGIBLE devices, re-queried from the runtime NOW: the roster
+        minus lost, quarantined, and still-probationary ids. Probationary
+        devices are healthy hardware but do not count toward a mesh until
+        they graduate (the anti-flap contract)."""
+        excluded = self._lost_ids | self._quarantined | set(self._probation)
+        return [d for d in jax.devices() if d.id not in excluded]
 
     @property
     def n_total(self) -> int:
@@ -73,6 +116,29 @@ class ElasticPool:
     @property
     def n_lost(self) -> int:
         return len(self._lost_ids)
+
+    @property
+    def n_probation(self) -> int:
+        return len(self._probation)
+
+    @property
+    def n_quarantined(self) -> int:
+        return len(self._quarantined)
+
+    def is_lost(self, device) -> bool:
+        return (device if isinstance(device, int) else device.id) in self._lost_ids
+
+    def is_probationary(self, device) -> bool:
+        return (device if isinstance(device, int) else device.id) in self._probation
+
+    def is_quarantined(self, device) -> bool:
+        return (device if isinstance(device, int) else device.id) in self._quarantined
+
+    def recently_lost(self, k: int) -> List[int]:
+        """The k most recently lost ids, most recent first — what a
+        ``device_rejoin`` drill heals (the device that just blipped is the
+        one whose tunnel recycles)."""
+        return list(reversed(self._lost_order))[: max(0, int(k))]
 
     def summary(self) -> str:
         return f"{self.n_alive}/{self.n_total}"
@@ -96,6 +162,16 @@ class ElasticPool:
                 f"(ids {sorted(ids)}): the single-device floor needs one"
             )
         before = self.n_alive
+        # Losing a probationary device is a FLAP half-cycle: it leaves
+        # probation and re-enters the lost set (its flap history survives,
+        # so the next heal can see it is oscillating). It was not eligible,
+        # so before == after for such a record — attributable, not a shrink.
+        for i in ids:
+            self._probation.pop(i, None)
+            self._probation_t0.pop(i, None)
+            if i in self._lost_order:
+                self._lost_order.remove(i)
+            self._lost_order.append(i)
         self._lost_ids |= ids
         record = {
             "before": before,
@@ -119,6 +195,121 @@ class ElasticPool:
             )
         return record
 
+    # ------------------------------------------------------------ grow-back
+
+    def _journal(self, kind: str, key: str, **payload) -> None:
+        if self.journal is not None:
+            from ..observability.trace import current_ids
+
+            self.journal.append(kind, key=key, site=self.site,
+                                **current_ids(), **payload)
+
+    def heal(self, devices: Iterable, cause: str = "device_rejoin") -> dict:
+        """Report devices as healed. A healed id leaves the exclusion set
+        only after it reappears in a fresh ``jax.devices()`` re-query; an
+        id the runtime cannot see yet stays lost and is retried by every
+        later :meth:`rejoin_check`. A verified rejoin enters probation
+        (``mesh_probation`` record) — or quarantine (``mesh_quarantine``)
+        when this heal completes the K-th flap inside the window. Returns
+        the transition record (``probation``/``absent``/``quarantined``
+        id lists)."""
+        ids = sorted({d if isinstance(d, int) else d.id for d in devices})
+        return self._rejoin(ids, cause)
+
+    def rejoin_check(self, cause: str = "rejoin_check") -> dict:
+        """Re-run the fresh-roster check over every heal still pending —
+        the consumers' between-batches hook (a recycled tunnel may take a
+        while to re-enumerate)."""
+        if not self._heal_pending:
+            return {"probation": [], "absent": [], "quarantined": []}
+        return self._rejoin(sorted(self._heal_pending), cause)
+
+    def _rejoin(self, ids: List[int], cause: str) -> dict:
+        roster = {d.id for d in jax.devices()}  # fresh re-query, never cached
+        probation: List[int] = []
+        absent: List[int] = []
+        quarantined: List[int] = []
+        for i in ids:
+            if i in self._quarantined:
+                # Quarantine is sticky: a flapping device does not get to
+                # oscillate the mesh by asking again.
+                self._heal_pending.discard(i)
+                quarantined.append(i)
+                continue
+            if i not in self._lost_ids:
+                self._heal_pending.discard(i)  # already eligible/probationary
+                continue
+            if i not in roster:
+                self._heal_pending.add(i)
+                absent.append(i)
+                continue
+            # Verified rejoin: this completes one lose->heal flap cycle.
+            flaps = [t for t in self._flaps.get(i, [])
+                     if self._clock - t <= self.flap_window]
+            flaps.append(self._clock)
+            self._flaps[i] = flaps
+            self._lost_ids.discard(i)
+            self._lost_order.remove(i)
+            self._heal_pending.discard(i)
+            if len(flaps) >= self.quarantine_flaps:
+                self._quarantined.add(i)
+                quarantined.append(i)
+                self._journal(
+                    "mesh_quarantine",
+                    key=f"quarantine:{i}",
+                    device=i,
+                    flaps=len(flaps),
+                    window=self.flap_window,
+                    cause=cause,
+                )
+            else:
+                self._probation[i] = self.probation_steps
+                self._probation_t0[i] = time.monotonic()
+                probation.append(i)
+        record = {"probation": probation, "absent": absent,
+                  "quarantined": quarantined}
+        if probation:
+            self._journal(
+                "mesh_probation",
+                key=f"probation:{','.join(map(str, probation))}",
+                event="enter",
+                devices=probation,
+                probation_steps=self.probation_steps,
+                cause=cause,
+            )
+            if self.probation_steps == 0:
+                # N=0 disables the hysteresis: graduate immediately.
+                self.note_clean_batch(0)
+        return record
+
+    def note_clean_batch(self, n: int = 1) -> List[int]:
+        """One clean supervised step/batch elapsed: advance the flap-window
+        clock and tick every probation counter. Devices reaching 0 graduate
+        back into ``alive()`` (journaled ``mesh_probation`` event="pass" —
+        the record a promotion decision is allowed to build on). Returns
+        the graduated ids."""
+        self._clock += max(0, int(n))
+        passed: List[int] = []
+        for i in list(self._probation):
+            self._probation[i] -= n
+            if self._probation[i] <= 0:
+                del self._probation[i]
+                passed.append(i)
+        if passed:
+            ms = max(
+                (time.monotonic() - self._probation_t0.pop(i, time.monotonic()))
+                * 1e3
+                for i in passed
+            )
+            self._journal(
+                "mesh_probation",
+                key=f"probation-pass:{','.join(map(str, passed))}",
+                event="pass",
+                devices=sorted(passed),
+                ms=round(ms, 3),
+            )
+        return passed
+
     # -------------------------------------------------------------- build
 
     def mesh_for(self, n_shards: int, axis_name: str = "sp", dp: int = 1) -> Mesh:
@@ -134,16 +325,18 @@ class ElasticPool:
         )
 
 
-def seeded_victims(pool: ElasticPool, k: int, seed) -> List[jax.Device]:
-    """k seeded victims among the pool's survivors — never the lowest-id
-    survivor, which the single-device floor (and the chaos drill's clean
-    comparison run) lands on. Deterministic per (seed, surviving set)."""
+def seeded_victims(pool: ElasticPool, k: int, seed, site: str = "mesh_shrink") -> List[jax.Device]:
+    """k seeded victims among the pool's survivors, clamped so at least one
+    device survives. Deterministic per (seed, site, surviving set). ANY
+    survivor — the lowest-id/default device included — is a legal victim:
+    the single@1 floor builds over ``pool.alive()[0]`` re-queried at trip
+    time (ROADMAP item 3 leftover (d)), so no drill needs to spare it."""
     alive = pool.alive()
     k = max(0, min(int(k), len(alive) - 1))
     if k == 0:
         return []
-    rng = random.Random(f"{seed}:mesh_shrink")
-    return rng.sample(alive[1:], k)
+    rng = random.Random(f"{seed}:{site}")
+    return rng.sample(alive, k)
 
 
 def reshard_tree(tree: PyTree, mesh: Mesh, spec: Optional[P] = None) -> PyTree:

@@ -36,6 +36,19 @@ fires would report "recovery path exercised" without exercising anything):
                       drops 1 device per fired draw. The supervisor must
                       rebuild Mesh/shard_map closures over the survivors,
                       reshard live state, and replay the failed batch/step.
+    device_rejoin     resilience.supervisor grow-back — heal the k most
+                      recently lost devices (magnitude via ``drain``, like
+                      mesh_shrink). A heal is verified against a fresh
+                      ``jax.devices()`` re-query and lands in PROBATION,
+                      never straight into a mesh; the site no-ops (without
+                      consuming its budget) until something is lost, so
+                      ``mesh_shrink=1,device_rejoin=1`` sequences
+                      lose-then-heal deterministically.
+    flap              resilience.supervisor grow-back — bounce ONE seeded
+                      device through k lose->heal cycles (magnitude via
+                      ``drain``), one half-cycle per supervised step. The
+                      pool must quarantine the flapper (``mesh_quarantine``)
+                      instead of oscillating the mesh.
     kernel_compile    run CLI build step (pallas tier) — Mosaic lowering
                       failure; degrades Pallas -> XLA reference tier.
     subprocess_wedge  harness.run_case — the classic wedged-tunnel capture
@@ -75,6 +88,8 @@ KNOWN_SITES = (
     "nan_loss",
     "stage_sdc",
     "mesh_shrink",
+    "device_rejoin",
+    "flap",
 )
 
 

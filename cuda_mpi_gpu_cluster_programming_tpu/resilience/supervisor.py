@@ -38,11 +38,28 @@ batch (journaled ``sup_step``/``sup_replay``) instead of whole-checkpoint
 rollback, with the checkpoint rollback remaining the floor
 (train.py ``--supervise-steps`` / ``--max-rollbacks``).
 
+Since PR 10 the ladder is a closed loop — degradation has an inverse
+(docs/RESILIENCE.md "Grow-back & hysteresis"): :meth:`Supervisor.promote`
+climbs BACK up when the pool's eligible count satisfies a higher rung
+(a healed device rejoined, sat out its probation, and graduated). A
+promotion rebuilds the higher rung's Mesh/shard_map closures over the
+re-queried eligible set, live-reshards params (and opt-state) UP, and —
+before switching — verifies the candidate rung against the CURRENT rung's
+output on a sentinel input: a promotion that changes results is refused
+and journaled (``sup_promote_refused``), never silently adopted. The whole
+transition runs under one ``sup.recover`` span so an exported incident
+timeline reads trip → degrade → heal → probation → promote end to end.
+Consumers drive it between batches/steps via :meth:`maybe_promote`
+(serving dispatch loop, ``train.py --supervise-steps``).
+
 Every recovery path is drillable on CPU: ``CHAOS_SPEC="stage_sdc=1"``
 corrupts a seeded stage digest before screening, ``device_loss=1`` raises
-the mesh-shrink signature before the forward runs, and ``mesh_shrink=k``
+the mesh-shrink signature before the forward runs, ``mesh_shrink=k``
 actually drops k seeded devices from the pool so the rebuild lands on a
-genuinely smaller mesh (docs/RESILIENCE.md).
+genuinely smaller mesh, ``device_rejoin=k`` heals the k most recently
+lost devices back through probation, and ``flap=k`` bounces ONE seeded
+device through k lose→heal cycles — which must end in quarantine, never
+mesh oscillation (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
@@ -164,6 +181,7 @@ class Supervisor:
         pool=None,
         step_builder: Optional[Callable] = None,
         site: str = "supervisor",
+        promote_rtol: float = 1e-5,
     ):
         if not ladder:
             raise ValueError("Supervisor needs a non-empty ladder")
@@ -192,16 +210,38 @@ class Supervisor:
         # loss[, grad_norm]). See training.make_elastic_step_builder.
         self.step_builder = step_builder
         self.site = site
+        # The grow-back sentinel bar: a promotion candidate whose
+        # spot-check output deviates from the current rung by more than
+        # this oracle-max-normalized budget is refused (shard-count
+        # reduction reordering costs ~1 ulp; a broken device costs orders
+        # of magnitude more).
+        self.promote_rtol = float(promote_rtol)
         self.checker = StageDigests(sentinel_cfg, site=site)
         self.trips: List[SDC] = []
         self.events: List[DegradedEvent] = []
         self.attempts = 0
         self.replays = 0  # batches/steps re-run on a new rung after a trip
+        self.promotions = 0  # grow-back climbs committed (maybe_promote)
         self.compile_ms: Optional[float] = None
         self._idx = 0
         self._fwd: Optional[Callable] = None
         self._sfn: Optional[Callable] = None
         self._step = 0
+        # Promotion hysteresis floor: the pool's eligible count recorded at
+        # the last degrade (or refused/committed promotion). maybe_promote
+        # fires only when the eligible count GROWS past it — a transient
+        # device_loss trip (pool unchanged) or a refused candidate never
+        # re-promotes every batch.
+        self._promote_floor_alive: Optional[int] = None
+        # chaos `flap` drill state: the one seeded device being bounced and
+        # the remaining lose->heal cycles / last step a transition ran.
+        self._flap_cycles = 0
+        self._flap_device = None
+        self._flap_last_step: Optional[int] = None
+        # The step whose trip is still being recovered: the chaos rejoin
+        # defers past it so a heal never lands inside the same step's
+        # replay (drills stay deterministic step-for-step).
+        self._rejoin_blocked_step: Optional[int] = None
 
     # ------------------------------------------------------------ building
 
@@ -400,6 +440,70 @@ class Supervisor:
             f"{self.pool.n_total} devices survive",
         )
 
+    def _maybe_chaos_device_rejoin(self) -> None:
+        """The ``device_rejoin=k`` drill: heal the k most recently lost
+        devices back into the pool (verified against a fresh device
+        re-query, so they land in probation — never straight into a mesh).
+        No-op until something is actually lost, so a combined
+        ``mesh_shrink=1,device_rejoin=1`` spec sequences lose-then-heal
+        deterministically across steps without consuming the heal early
+        (a step's own replay never consumes the rejoin either)."""
+        ch = chaos.active()
+        if ch is None or self.pool.n_lost == 0:
+            return
+        if self._step == self._rejoin_blocked_step:
+            return
+        k = ch.drain("device_rejoin")
+        if k == 0 and ch.draw("device_rejoin"):
+            k = 1
+        if k == 0:
+            return
+        self.pool.heal(self.pool.recently_lost(k), cause="chaos:device_rejoin")
+
+    def _maybe_chaos_flap(self, entry: LadderEntry) -> None:
+        """The ``flap=k`` drill: ONE seeded device bounces through k
+        lose→heal cycles, one half-cycle per supervised step. The first
+        lose hits a device inside the active mesh and trips; every later
+        bounce happens while the device is probationary — excluded from
+        every mesh — so the ladder must stay put until the pool
+        quarantines the flapper (the anti-flap acceptance)."""
+        ch = chaos.active()
+        if ch is None:
+            return
+        self._flap_cycles += ch.drain("flap")
+        if self._flap_cycles <= 0:
+            return
+        if self._flap_last_step == self._step:
+            return  # one transition per step, not per replay attempt
+        pool = self.pool
+        if self._flap_device is None:
+            from ..parallel.elastic import seeded_victims
+
+            victims = seeded_victims(pool, 1, ch.spec.seed, site="flap")
+            if not victims:
+                self._flap_cycles = 0
+                return
+            self._flap_device = victims[0]
+        d = self._flap_device
+        if pool.is_quarantined(d):
+            self._flap_cycles = 0  # hysteresis won: the bounce is over
+            return
+        self._flap_last_step = self._step
+        if pool.is_lost(d):
+            pool.heal([d], cause="chaos:flap")
+            self._flap_cycles -= 1
+            return
+        was_probationary = pool.is_probationary(d)
+        pool.lose([d], cause="chaos:flap")
+        if not was_probationary and entry.n_shards > 1:
+            # The device was part of the active mesh: this lose is a real
+            # topology change and must trip like any other device loss.
+            raise chaos.InjectedFault(
+                "mesh_shrink",
+                f"flap: lost device {d.id}; entry {entry.key} mesh is stale "
+                f"— {pool.n_alive} of {pool.n_total} devices survive",
+            )
+
     def _maybe_chaos_stage_sdc(self, digests: Dict) -> Dict:
         ch = chaos.active()
         if ch is None or not digests:
@@ -494,6 +598,8 @@ class Supervisor:
                 self._advance(f"build failed: {type(e).__name__}: {e}"[:200], e)
                 continue
             try:
+                self._maybe_chaos_device_rejoin()
+                self._maybe_chaos_flap(entry)
                 self._maybe_chaos_mesh_shrink(entry)
                 self._maybe_chaos_device_loss(entry)
                 t0 = time.perf_counter()
@@ -524,6 +630,10 @@ class Supervisor:
                 entry=self.entry.key,
                 attempts=self.attempts,
             )
+            # One clean batch: the probation clock ticks (grow-back
+            # hysteresis) — a rejoined device graduates only after N of
+            # these, never on the heal itself.
+            self.pool.note_clean_batch()
             self._step += 1
             return out
 
@@ -568,6 +678,11 @@ class Supervisor:
             )
             with obs_span("sup.degrade", frm=entry_key):
                 self._advance(advance_cause, sdc)
+            # Arm the grow-back path: promotion requires the eligible count
+            # to GROW past what this degrade landed with — a transient trip
+            # that lost no pool device can never oscillate back up.
+            self._promote_floor_alive = self.pool.n_alive
+            self._rejoin_blocked_step = self._step
             return self._replay_state(tree)
 
     @off_timed_path
@@ -604,6 +719,8 @@ class Supervisor:
                 params, opt_state = self._replay_state((params, opt_state))
                 continue
             try:
+                self._maybe_chaos_device_rejoin()
+                self._maybe_chaos_flap(entry)
                 self._maybe_chaos_mesh_shrink(entry)
                 self._maybe_chaos_device_loss(entry)
                 out = fn(params, opt_state, x, y)
@@ -640,6 +757,7 @@ class Supervisor:
                 attempts=self.attempts,
                 replays=self.replays,
             )
+            self.pool.note_clean_batch()  # grow-back probation clock
             self._step += 1
             return out
 
@@ -656,6 +774,194 @@ class Supervisor:
             f"SDC({e.kind}): {e.detail}"[:200], (params, opt_state),
         )
 
+    # ------------------------------------------------------------ grow-back
+
+    def _spot_batch(self):
+        """The deterministic sentinel input a promotion is verified on (and,
+        in training mode, a fixed target so the loss is well-defined)."""
+        from ..models.alexnet import output_shape
+        from ..models.init import deterministic_input
+
+        x = deterministic_input(1, self.model_cfg)
+        oh, ow, oc = output_shape(self.model_cfg)
+        y = np.zeros((1, oh, ow, oc), np.float32)
+        return x, y
+
+    def _promotion_target(self) -> Optional[int]:
+        """The highest rung above the current one the ELIGIBLE pool
+        satisfies (probationary/quarantined devices do not count — the
+        hysteresis contract), or None."""
+        for j in range(self._idx):
+            entry = self.ladder[j]
+            if entry.strategy == "single" or entry.n_shards <= self.pool.n_alive:
+                return j
+        return None
+
+    @off_timed_path
+    def maybe_promote(self, params, opt_state=None):
+        """The consumers' between-batches grow-back hook: retry pending
+        heals against a fresh device re-query, tick nothing (clean batches
+        tick via execute/supervise_step), and — when the eligible count has
+        GROWN past the last degrade's floor and a higher rung is
+        satisfiable — run the full supervised promotion. Returns None when
+        nothing changed; otherwise the live state resharded onto the
+        promoted rung (``params``, or ``(params, opt_state)`` when
+        ``opt_state`` is given)."""
+        self.pool.rejoin_check()
+        if self._idx == 0:
+            return None
+        if (
+            self._promote_floor_alive is None
+            or self.pool.n_alive <= self._promote_floor_alive
+        ):
+            return None
+        target = self._promotion_target()
+        if target is None or target >= self._idx:
+            return None
+        return self.promote(params, opt_state=opt_state, target_idx=target)
+
+    @off_timed_path
+    def promote(self, params, opt_state=None, target_idx: Optional[int] = None):
+        """The inverse of a trip, as one supervised transition under a
+        parent ``sup.recover`` span: rebuild the target rung's closures
+        over the re-queried eligible devices, live-reshard the state UP
+        (``reshard_tree``/``reshard_train_state`` semantics via
+        :meth:`reshard`), verify the candidate against the CURRENT rung's
+        output on a sentinel input, and only then switch. A candidate that
+        fails to build falls to the next rung down; one that changes
+        results is refused and journaled ``sup_promote_refused`` — never
+        silently adopted. Returns the resharded state, or None when no
+        rung was adopted."""
+        if target_idx is None:
+            target_idx = self._promotion_target()
+        if target_idx is None or target_idx >= self._idx:
+            return None
+        training = self.step_builder is not None and opt_state is not None
+        cur = self.entry
+        state = (params, opt_state) if training else params
+        t_start = time.perf_counter()
+        with obs_span(
+            "sup.recover", frm=cur.key, pool=self.pool.summary()
+        ) as sp:
+            for j in range(target_idx, self._idx):
+                entry = self.ladder[j]
+                if entry.strategy != "single" and entry.n_shards > self.pool.n_alive:
+                    continue
+                try:
+                    ok, refused_reason, built = self._verify_candidate(
+                        entry, params, opt_state, training
+                    )
+                except Exception as e:  # noqa — unbuildable candidate: the
+                    # next rung down may still fit the eligible set.
+                    continue
+                if not ok:
+                    # The sentinel caught a promotion that changes results:
+                    # refuse it attributably and raise the hysteresis floor
+                    # so this candidate is not retried every batch.
+                    self._journal(
+                        "sup_promote_refused",
+                        key=f"promote-refused:{entry.key}",
+                        frm=cur.key,
+                        to=entry.key,
+                        devices=self.pool.n_alive,
+                        cause=refused_reason[:200],
+                    )
+                    if sp is not None:
+                        sp.set(refused=entry.key)
+                    self._promote_floor_alive = self.pool.n_alive
+                    return None
+                # Adopt: switch the rung, then reshard the live state onto
+                # its mesh (journaled sup_reshard) and let the consumer
+                # re-warm (serving compiles every bucket here, BEFORE the
+                # next dispatch — zero post-promotion cache misses).
+                self._idx = j
+                if training:
+                    self._sfn, self._fwd = built, None
+                else:
+                    self._fwd, self._sfn = built, None
+                with obs_span("sup.promote", frm=cur.key, to=entry.key):
+                    state = self.reshard(state)
+                    if self.on_rebuild is not None:
+                        self.on_rebuild(entry)
+                    self.promotions += 1
+                    self._promote_floor_alive = self.pool.n_alive
+                    self._journal(
+                        "sup_promote",
+                        key=f"promote:{self.promotions}",
+                        frm=cur.key,
+                        to=entry.key,
+                        devices=self.pool.n_alive,
+                        step=self._step,
+                        ms=round((time.perf_counter() - t_start) * 1e3, 3),
+                    )
+                return state
+        return None
+
+    @off_timed_path
+    def _rel_err(self, a, b) -> float:
+        """Oracle-max-normalized deviation (the precision-gate metric):
+        max|a-b| / max|a|, over trees or arrays. Promotion-path only —
+        contractually between timed regions."""
+        import jax
+
+        worst = 0.0
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        if len(la) != len(lb):
+            return float("inf")
+        for x, y in zip(la, lb):
+            x, y = np.asarray(x, np.float64), np.asarray(y, np.float64)
+            if x.shape != y.shape:
+                return float("inf")
+            scale = max(float(np.max(np.abs(x))), 1e-30)
+            worst = max(worst, float(np.max(np.abs(x - y))) / scale)
+        return worst
+
+    def _verify_candidate(self, entry: LadderEntry, params, opt_state, training):
+        """Build the candidate rung and spot-check it against the CURRENT
+        rung on the sentinel batch. Returns ``(ok, reason, built)`` where
+        ``built`` is the candidate executable (step_fn in training mode,
+        forward otherwise). The bar is ``promote_rtol`` (default 1e-5,
+        sentinel-tight): a different shard count legitimately reorders
+        float reductions by an ulp or two, but a rejoined device that
+        computes WRONG results — the fault promotion must never re-adopt —
+        misses by orders of magnitude. Outputs stay bit-identical against
+        topology-PINNED references (the PR 8 contract; the drills assert
+        both)."""
+        import jax
+
+        from ..parallel.elastic import reshard_tree
+
+        x, y = self._spot_batch()
+        mesh = self.pool.mesh_for(
+            max(1, entry.n_shards if entry.strategy != "single" else 1)
+        )
+        if training:
+            cand = self.step_builder(entry, self._entry_mesh(entry))
+            cur_fn = self.step_fn()
+            p2, o2 = reshard_tree((params, opt_state), mesh)
+            a = cur_fn(params, opt_state, x, y)
+            b = cand(p2, o2, x, y)
+            jax.block_until_ready(b[2])
+            rel = max(
+                self._rel_err(a[0], b[0]),
+                self._rel_err(np.float64(a[2]), np.float64(b[2])),
+            )
+        else:
+            cand = self._build_entry(entry)
+            cur_fn = self.fwd()
+            p2 = reshard_tree(params, mesh)
+            a, _ = cur_fn(params, x)
+            b, _ = cand(p2, x)
+            rel = self._rel_err(a, b)
+        if rel > self.promote_rtol:
+            return False, (
+                f"sentinel spot-check mismatch: candidate {entry.key} "
+                f"diverges from {self.entry.key} by rel {rel:.3e} "
+                f"(> promote_rtol {self.promote_rtol:g})"
+            ), cand
+        return True, "", cand
+
     # ------------------------------------------------------------ surfacing
 
     @property
@@ -670,5 +976,6 @@ class Supervisor:
             f"attempts={self.attempts} trips={len(self.trips)} "
             f"degradations={len(self.events)} entry={self.entry.key} "
             f"kinds={kinds} replays={self.replays} "
-            f"pool={self.pool.summary()}"
+            f"promotions={self.promotions} pool={self.pool.summary()} "
+            f"quarantined={self.pool.n_quarantined}"
         )

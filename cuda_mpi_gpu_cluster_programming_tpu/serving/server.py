@@ -83,6 +83,7 @@ class ServeStats:
     warmup_compiles: int = 0
     cache_misses: int = 0  # post-warmup dispatches at an un-warmed shape
     rewarm_ms: float = 0.0  # wall ms spent re-compiling buckets on degrades
+    promotions: int = 0  # supervised grow-back climbs committed mid-serve
     batch_ms: List[float] = dataclasses.field(default_factory=list)
 
     def summary(self) -> str:
@@ -289,11 +290,34 @@ class InferenceServer:
     # ------------------------------------------------------------- dispatch
 
     def _step(self) -> None:
+        # Grow-back check FIRST, strictly between batches (off the dispatch
+        # timed region): a healed+graduated pool promotes the supervisor —
+        # and re-warms every bucket at the higher rung — before the next
+        # batch is even assembled, so in-flight requests are never dropped
+        # and no post-promotion dispatch can miss the compile cache.
+        self._maybe_promote()
         batch, shed = self._batcher.next_batch(self.cfg.poll_s)
         if shed:
             self._record_shed(shed)
         if batch is not None:
             self._dispatch(batch)
+
+    @off_timed_path
+    def _maybe_promote(self) -> None:
+        """Between-batches grow-back (docs/RESILIENCE.md "Grow-back &
+        hysteresis"): retry pending heals against a fresh device re-query
+        and, when the eligible pool satisfies a higher rung, run the
+        supervised promotion. The supervisor's ``on_rebuild`` hook fires
+        ``_rewarm`` inside the promotion, so every bucket is compiled at
+        the higher rung before this returns — the cutover costs zero
+        cache misses on the post-promotion dispatch path."""
+        if self.sup is None:
+            return
+        state = self.sup.maybe_promote(self._params)
+        if state is not None:
+            self._params = state
+            self.stats.promotions += 1
+            metrics_registry().counter("serve.promotions").inc()
 
     def _dispatch(self, batch: AssembledBatch) -> None:
         """One timed region: pad -> run -> fence. Completion (slicing,
@@ -444,7 +468,12 @@ class InferenceServer:
         """One machine-parseable line ('Serve: ...' — run CLI contract)."""
         s = self.stats.summary()
         buckets = ",".join(str(b) for b in self.buckets)
-        tail = f" entry={self.sup.entry.key} trips={len(self.sup.trips)}" if self.sup else ""
+        tail = (
+            f" entry={self.sup.entry.key} trips={len(self.sup.trips)}"
+            f" promotions={self.sup.promotions}"
+            if self.sup
+            else ""
+        )
         return f"{s} buckets={buckets}{tail}"
 
 

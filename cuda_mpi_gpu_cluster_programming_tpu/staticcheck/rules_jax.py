@@ -52,6 +52,9 @@ gate that cries wolf gets ``# noqa``'d into uselessness.
                          ON; a deliberate disable documents itself with
                          ``# noqa: check-vma-disabled <reason>``).
   stale-device-set     — a Mesh/make_mesh/mesh_for call inside a function
+                         (the grow-back paths hold the same discipline:
+                         ElasticPool.heal admits a rejoining device only
+                         after a FRESH jax.devices() re-query shows it)
                          consuming a MODULE-cached ``jax.devices()`` /
                          ``jax.local_devices()`` list. By the time a
                          rebuild/retry path runs, the device set may have
@@ -900,6 +903,15 @@ class StaleDeviceSetRule(Rule):
                 for t in stmt.targets:
                     if isinstance(t, ast.Name):
                         cached.add(t.id)
+            elif (
+                isinstance(stmt, ast.AnnAssign)
+                and stmt.value is not None
+                and isinstance(stmt.target, ast.Name)
+                and _is_device_query(stmt.value)
+            ):
+                # Annotated spelling of the same cache:
+                # ``DEVICES: List[jax.Device] = jax.devices()``.
+                cached.add(stmt.target.id)
         if not cached:
             return []
         fn_spans = [

@@ -149,7 +149,9 @@ def _run_resilient_loop(
     ``sdc``/``nan_loss`` drills exercise exactly this path on CPU);
     ``--max-rollbacks`` consecutive trips without a successful checkpoint
     abort with rc 3. Returns either an exit code (int) or
-    ``(first_loss, last_loss, steps_run)``.
+    ``(first_loss, last_loss, steps_run, final_params, final_opt_state)``
+    — callers must take the trained state from the return value (a
+    rebound local never propagates back through an argument).
 
     With ``sup`` (``--supervise-steps``, an elastic
     :class:`~.resilience.supervisor.Supervisor` in step mode) the CHEAP
@@ -200,7 +202,13 @@ def _run_resilient_loop(
         return None
 
     while i < args.steps:
-        x = jax.device_put(get_batch(i))
+        x = get_batch(i)
+        if sup is None:
+            x = jax.device_put(x)
+        # Supervised mode leaves the batch UNCOMMITTED: an explicit
+        # device_put would pin it to the default device, which the elastic
+        # floor must not assume survives (ROADMAP item 3 leftover (d)) —
+        # placement follows the supervisor-resharded params instead.
         y = teacher_fwd(teacher, x)
         try:
             # One span per training step (no-op untraced): the supervisor's
@@ -274,10 +282,24 @@ def _run_resilient_loop(
             jr.append("ckpt", key=f"ckpt:{i}", step=i, **current_ids())
             last_good_step = i
             rollbacks = 0  # progress made: reset the consecutive-trip budget
+        if sup is not None:
+            # Grow-back check between steps: pending heals are retried
+            # against a fresh device re-query, and once a rejoined device
+            # graduates probation the supervisor climbs the ladder back up
+            # — mid-run, with the live state resharded onto the promoted
+            # rung (no restart, no checkpoint round-trip).
+            promoted = sup.maybe_promote(student, opt_state)
+            if promoted is not None:
+                student, opt_state = promoted
+                print(
+                    f"Elastic promote: climbed back to {sup.entry.key} "
+                    f"(pool={sup.pool.summary()})",
+                    flush=True,
+                )
     flog.record("ok")
     if flog.retried:
         print(f"Sentinel fault log: {flog.summary()}")
-    return first, last, steps_run
+    return first, last, steps_run, student, opt_state
 
 
 def main(argv=None) -> int:
@@ -494,7 +516,11 @@ def main(argv=None) -> int:
                 set_tracer(None)  # in-process callers must not leak a tracer
         if isinstance(rc, int):
             return rc
-        first, last, steps_run = rc
+        # Take the TRAINED state back from the loop: --checkpoint below
+        # must save what the run actually learned (the loop's locals never
+        # flow back through its arguments; saving the pre-loop `student`
+        # here silently exported the INITIAL params).
+        first, last, steps_run, student, opt_state = rc
         if sup is not None:
             # Machine-parseable elastic summary (scripts/on_heal.sh gates
             # on 'Elastic: .*replays='): rung, trip kinds, replay count,

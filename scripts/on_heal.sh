@@ -83,6 +83,49 @@ else
     say "MESH-SHRINK DRILL FAILED — elastic rebuild path broken; fix before relying on preemption-riding this window (log: $MS_LOG)"
 fi
 
+say "grow-back drill (seeded shrink -> heal -> probation -> promote on the CPU serving mesh — docs/RESILIENCE.md 'Grow-back & hysteresis')"
+# The self-healing loop is PROVEN before chip time, same policy as the
+# shrink drill above: a seeded device loss degrades the service, an
+# explicit heal walks the device through probation, and the dispatch loop
+# must PROMOTE back — sup_promote journaled, post-promote rate within
+# tolerance of the pre-loss rate, zero post-promotion cache misses. A
+# fleet that can only grow back by restarting should learn that here,
+# not mid-incident. The journal exports as a Perfetto incident timeline
+# (trip -> degrade -> heal -> probation -> promote on one lane).
+GROW_JOURNAL="logs/grow_drill_${FTS}.jsonl"
+if timeout 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    GROW_JOURNAL="$GROW_JOURNAL" \
+    python - >>"$LOG" 2>&1 <<'EOF'
+import dataclasses, json, os, sys
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+import bench
+
+cfg = dataclasses.replace(BLOCKS12, in_height=63, in_width=63)
+row = bench._serve_grow_drill(cfg, journal_path=os.environ["GROW_JOURNAL"])
+print(json.dumps(row))
+kinds = [r["kind"] for r in Journal.load(os.environ["GROW_JOURNAL"])]
+ok = (
+    row["completed"] == row["n_requests"]
+    and row["promotions"] >= 1
+    and row["recovered"] is True
+    and row["cache_misses_post_promote"] == 0
+    and "sup_promote" in kinds
+    and "mesh_probation" in kinds
+)
+sys.exit(0 if ok else 1)
+EOF
+then
+    say "grow-back drill OK (sup_promote journaled, post-promote rate within tolerance, zero post-promote misses; journal: $GROW_JOURNAL)"
+else
+    say "GROW-BACK DRILL FAILED — self-healing path broken; fix before relying on grow-back this window (journal: $GROW_JOURNAL)"
+fi
+timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    export --journal "$GROW_JOURNAL" \
+    --out "logs/trace_grow_${FTS}.json" 2>&1 | tee -a "$LOG" \
+    || say "grow-back trace export failed — see $LOG"
+
 say "serve smoke (continuous-batching Poisson drill on the CPU mesh — docs/SERVING.md)"
 # The serving path is PROVEN before any heal-window chip time, same policy
 # as the supervisor drill above: a short journaled Poisson run through the

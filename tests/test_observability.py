@@ -308,6 +308,46 @@ def test_export_spans_and_synthetic_journal_roundtrip(tmp_path):
     assert {e["pid"] for e in xs} <= meta_pids
 
 
+def test_export_grow_back_records_on_incident_lane(tmp_path):
+    """ISSUE 10 satellite: the four grow-back record kinds render on the
+    supervisor (incident) lane — sup_promote and a probation "pass" as
+    SLICES (they carry ms), probation "enter"/quarantine/refusal as
+    instants — so an exported incident reads trip -> degrade -> heal ->
+    probation -> promote end to end. Journals without them (pre-ISSUE-10)
+    export unchanged, which the older roundtrip tests pin."""
+    jp = tmp_path / "j.jsonl"
+    j = Journal(jp)
+    j.append("sup_trip", key="trip:1", sdc_kind="mesh_shrink", step=0)
+    j.append("mesh_shrink", key="shrink:8->7", before=8, after=7, lost=[3])
+    j.append("mesh_probation", key="probation:3", event="enter", devices=[3],
+             probation_steps=2, cause="chaos:device_rejoin")
+    j.append("mesh_probation", key="probation-pass:3", event="pass",
+             devices=[3], ms=12.5)
+    j.append("sup_promote_refused", key="promote-refused:halo@4:reference",
+             frm="halo@2:reference", to="halo@4:reference", devices=8,
+             cause="sentinel spot-check mismatch")
+    j.append("sup_promote", key="promote:1", frm="halo@2:reference",
+             to="halo@4:reference", devices=8, step=3, ms=41.0)
+    j.append("mesh_quarantine", key="quarantine:5", device=5, flaps=3,
+             window=64, cause="chaos:flap")
+    trace = to_trace_events(Journal.load(jp))
+    _validate_nesting(trace)
+    evs = {e["name"]: e for e in trace["traceEvents"] if e["ph"] in "Xi"}
+    sup_pid = evs["sup_trip"]["pid"]
+    for kind in ("mesh_probation", "mesh_quarantine", "sup_promote",
+                 "sup_promote_refused"):
+        assert evs[kind]["pid"] == sup_pid, kind  # one incident lane
+    assert evs["sup_promote"]["ph"] == "X"  # ms -> slice
+    assert evs["sup_promote"]["dur"] == pytest.approx(41.0 * 1e3)
+    assert evs["sup_promote"]["args"]["frm"] == "halo@2:reference"
+    assert evs["mesh_quarantine"]["ph"] == "i"
+    assert evs["sup_promote_refused"]["ph"] == "i"
+    # the probation pair: enter is an instant, pass a slice via its ms
+    probations = [e for e in trace["traceEvents"]
+                  if e["name"] == "mesh_probation"]
+    assert sorted(e["ph"] for e in probations) == ["X", "i"]
+
+
 def test_export_correlated_record_pins_to_span(tmp_path):
     jp = tmp_path / "j.jsonl"
     tr = Tracer(journal=Journal(jp), seed=1)

@@ -186,6 +186,31 @@ def test_chaos_known_sites_include_sdc_and_nan_loss():
     assert "sdc" in chaos.KNOWN_SITES
     assert "nan_loss" in chaos.KNOWN_SITES
     assert "mesh_shrink" in chaos.KNOWN_SITES  # PR 8: elastic-mesh drills
+    # ISSUE 10: grow-back drills — validated vocabulary, so a typo'd heal
+    # drill fails loudly instead of silently never healing.
+    assert "device_rejoin" in chaos.KNOWN_SITES
+    assert "flap" in chaos.KNOWN_SITES
+
+
+def test_chaos_grow_back_sites_drain_with_mesh_shrink_semantics():
+    """device_rejoin/flap counts are MAGNITUDES consumed as one event via
+    drain (heal k devices at once / k lose->heal cycles), exactly the
+    mesh_shrink contract — and the streams are per-site deterministic."""
+    inj = chaos.ChaosInjector(
+        chaos.ChaosSpec.parse("seed=3,device_rejoin=2,flap=3")
+    )
+    assert inj.drain("device_rejoin") == 2
+    assert inj.drain("device_rejoin") == 0  # one event, not two
+    assert inj.drain("flap") == 3
+    assert inj.drain("flap") == 0
+    assert inj.fired == {"device_rejoin": 2, "flap": 3}
+    # probabilistic spelling stays on the seeded per-site draw stream
+    a = chaos.ChaosInjector(chaos.ChaosSpec.parse("seed=7,device_rejoin=p0.5"))
+    b = chaos.ChaosInjector(chaos.ChaosSpec.parse("seed=7,device_rejoin=p0.5"))
+    draws_a = [a.draw("device_rejoin") for _ in range(32)]
+    draws_b = [b.draw("device_rejoin") for _ in range(32)]
+    assert draws_a == draws_b and any(draws_a) and not all(draws_a)
+    assert a.drain("device_rejoin") == 0  # drain never touches p-streams
 
 
 def test_chaos_drain_consumes_count_as_one_magnitude():

@@ -391,6 +391,82 @@ def test_chaos_mesh_shrink_drill_server_survives_with_zero_misses(
         assert np.array_equal(a.result, b.result)
 
 
+def test_grow_back_drill_promotes_with_zero_misses_bit_identical(
+    tmp_path, monkeypatch
+):
+    """ISSUE 10 serving drill: a seeded mesh shrink degrades the service;
+    healing the lost device puts it in probation; after N clean batches it
+    graduates and the dispatch loop PROMOTES back to the original rung
+    between batches — completed == offered end to end, ZERO cache misses
+    (every bucket re-warmed at the higher rung before cutover), and every
+    wave's outputs bit-identical to a clean server pinned to that wave's
+    topology."""
+    jpath = tmp_path / "serve.jsonl"
+    scfg = ServeConfig(config="v2.2_sharded", n_shards=4, max_batch=4,
+                       supervise=True, model_cfg=CFG, journal_path=str(jpath))
+    imgs = [_img(1.0 + 0.01 * i) for i in range(6)]
+
+    def _wave(server):
+        handles = [server.submit(im) for im in imgs]
+        server.run_until_drained()
+        return handles
+
+    srv = InferenceServer(scfg)
+    offered, results = 0, []
+    wave_pre = _wave(srv)  # clean wave at halo@4
+    monkeypatch.setenv(chaos.CHAOS_ENV, "seed=3,mesh_shrink=1")
+    chaos.reset()
+    wave_loss = _wave(srv)  # seeded loss: trip -> degrade -> replay
+    monkeypatch.delenv(chaos.CHAOS_ENV)
+    chaos.reset()
+    degraded = srv.sup.entry.key
+    assert [t.kind for t in srv.sup.trips] == ["mesh_shrink"]
+    assert srv.sup.pool.n_alive == 7
+    srv.sup.pool.heal(srv.sup.pool.recently_lost(1), cause="drill:heal")
+    assert srv.sup.pool.n_probation == 1
+    # One wave = two clean batches = the full probation (N=2). Promotion
+    # must NOT fire inside it — the device graduates on its last batch.
+    wave_prob = _wave(srv)
+    assert srv.sup.promotions == 0  # hysteresis: nothing during probation
+    assert srv.sup.pool.n_probation == 0  # ...but the device graduated
+    assert srv.sup.entry.key == degraded
+    wave_post = _wave(srv)  # first step promotes, then dispatches at halo@4
+    assert srv.sup.promotions == 1 and srv.stats.promotions == 1
+    assert srv.sup.entry.key == "halo@4:reference"
+    assert srv.sup.pool.summary() == "8/8"
+    # accounting + the zero-miss discipline across the WHOLE lifecycle
+    all_handles = [wave_pre, wave_loss, wave_prob, wave_post]
+    assert all(h.status == OK for wave in all_handles for h in wave)
+    assert srv.stats.cache_misses == 0
+    kinds = [r["kind"] for r in Journal.load(jpath)]
+    for a, b in [("mesh_shrink", "mesh_probation"),
+                 ("mesh_probation", "sup_promote")]:
+        assert kinds.index(a) < kinds.index(b)
+    # the promotion's re-warm lands BEFORE the first post-promotion batch
+    assert (
+        len([k for k in kinds if k == "serve_rewarm"]) == 2
+    )  # one per degrade, one per promote
+    # every wave bit-identical to a clean server pinned to its topology
+    for wave, entry_key in [(wave_pre, "halo@4:reference"),
+                            (wave_loss, degraded),
+                            (wave_post, "halo@4:reference")]:
+        from cuda_mpi_gpu_cluster_programming_tpu.resilience.supervisor import (
+            LadderEntry,
+        )
+
+        strategy, rest = entry_key.split("@")
+        n, tier = rest.split(":")
+        clean = InferenceServer(
+            dataclasses.replace(scfg, journal_path=""),
+            ladder=[LadderEntry(strategy, tier, int(n))],
+        )
+        clean_handles = [clean.submit(im) for im in imgs]
+        clean.run_until_drained()
+        for a, b in zip(wave, clean_handles):
+            assert b.status == OK
+            assert np.array_equal(a.result, b.result)
+
+
 def test_threaded_poisson_load_accounts_for_every_request(tmp_path):
     jpath = tmp_path / "serve.jsonl"
     srv = InferenceServer(
@@ -494,6 +570,18 @@ def test_bench_serve_mode_cpu_smoke(tmp_path):
     assert shrink["replayed"] == 1
     assert shrink["rewarm_ms"] > 0
     assert shrink["cache_misses_post_rewarm"] == 0
+    # ISSUE 10: the drill sub-object's mesh_grow row — lose, heal,
+    # probation, PROMOTE, with the throughput-recovery verdict.
+    grow = drill["mesh_grow"]
+    assert grow["completed"] == grow["n_requests"]
+    assert grow["promotions"] == 1
+    assert grow["trips"] == ["mesh_shrink"]
+    assert grow["promoted_entry"] != grow["degraded_entry"]
+    assert grow["recovered"] is True
+    assert grow["recovery_ms"] > 0
+    assert grow["pre_img_s"] > 0 and grow["post_img_s"] > 0
+    assert grow["cache_misses_post_promote"] == 0
+    assert grow["cache_misses_total"] == 0
     # the journal backs the reported percentiles
     assert len(request_latencies_from_journal(jpath)) == row["n_ok"]
     # ISSUE 9 CI satellite: serve rows carry a NON-EMPTY per-stage

@@ -462,6 +462,23 @@ def test_stale_device_set_make_mesh_kwarg_and_list_wrap(tmp_path):
     assert "re-query" in found[0].message
 
 
+def test_stale_device_set_annotated_module_cache_flagged(tmp_path):
+    """ISSUE 10: the annotated spelling of the module cache
+    (``DEVICES: list = jax.devices()``) is the same stale-device bug —
+    flagged like the bare assignment."""
+    p = tmp_path / "ann.py"
+    p.write_text(
+        "import jax\n"
+        "from jax.sharding import Mesh\n"
+        "DEVICES: list = jax.devices()\n"
+        "def rebuild(n):\n"
+        "    return Mesh(DEVICES[:n], ('sp',))\n"
+    )
+    found = findings_for(p, "stale-device-set")
+    assert [f.line for f in found] == [5]
+    assert "DEVICES" in found[0].message
+
+
 def test_implicit_upcast_triggers_in_hot_path_dirs(tmp_path):
     """ISSUE 7 satellite: a contraction over bf16/int8-cast operands with
     no explicit preferred_element_type, in a hot-path module, is flagged —
