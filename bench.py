@@ -58,7 +58,12 @@ SERVE_METRIC = "alexnet_blocks12_serve_images_per_sec"
 # journaled Poisson load run through serving.InferenceServer reporting
 # p50/p99 request latency + sustained img/s, plus a seeded device_loss
 # chaos drill proving in-flight requests finish via supervisor replay.
+# "saturate" = the saturation study (docs/SERVING.md "Saturation study"):
+# sweep offered load past capacity, one JSON row per rate with journal
+# AND metrics-registry percentiles (same estimator — they must agree)
+# and the located p99 knee (knee_rate_img_s) stamped on every row.
 MODE = os.environ.get("BENCH_MODE", "measure")
+SATURATE_METRIC = "alexnet_blocks12_serve_saturation"
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
 # Opt-in sweep: one JSON row per listed config (the V1->V5 story); unset =
@@ -824,6 +829,135 @@ def _serve_main() -> int:
         return fail(f"{type(e).__name__}: {e}"[:200], platform)
 
 
+def _saturate_main() -> int:
+    """BENCH_MODE=saturate: sweep offered load past capacity on ONE
+    served mesh and emit one JSON row PER RATE, each carrying the
+    located p99 knee (``knee_rate_img_s`` — null when the sweep never
+    crossed it: sweep higher).
+
+    The sweep rides the PR 9 metrics registry: per rate the registry is
+    reset and the row reports the journal-slice p99 AND the registry's
+    ``serve.request_ms`` p99 — same nearest-rank estimator over the same
+    population, so ``percentiles_agree`` must hold. Arrivals and class
+    draws are seeded (BENCH_SERVE_SEED): the knee is reproducible per
+    seed on an unloaded mesh.
+
+    Tunables (env): BENCH_SAT_RATES ("10,20,40,80" req/s — sweep past
+    capacity), BENCH_SAT_DURATION (2 s per rate), BENCH_SAT_SHAPE
+    ("steady" — rate points stay clean; shaped specs accepted),
+    BENCH_SAT_KNEE (3.0 — p99 multiple over the lowest rate's p99 that
+    marks the knee), plus the BENCH_SERVE_* service knobs. Always one
+    parseable JSON line per rate, exit 0.
+    """
+    import tempfile
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
+
+    def fail(msg: str, platform: str = "unknown") -> int:
+        row = _error_obj(msg, platform)
+        row["metric"] = SATURATE_METRIC
+        print(json.dumps(row))
+        return 0
+
+    ok, info = probe(PROBE_TIMEOUT)
+    if not ok:
+        return fail(f"device {info}")
+    platform = info
+    try:
+        import dataclasses
+
+        from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import BLOCKS12
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.trace import (
+            Tracer,
+            set_tracer,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.loadgen import (
+            saturation_sweep,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.server import (
+            InferenceServer,
+            ServeConfig,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.traffic import (
+            default_class_mix,
+            slo_policy,
+        )
+
+        model_cfg = dataclasses.replace(
+            BLOCKS12,
+            in_height=int(os.environ.get("BENCH_SERVE_HEIGHT", "227")),
+            in_width=int(os.environ.get("BENCH_SERVE_WIDTH", "227")),
+        )
+        journal_path = os.environ.get("BENCH_SERVE_JOURNAL") or os.path.join(
+            tempfile.gettempdir(), f"saturate_journal_{os.getpid()}.jsonl"
+        )
+        rates = [
+            float(r)
+            for r in os.environ.get("BENCH_SAT_RATES", "10,20,40,80").split(",")
+            if r.strip()
+        ]
+        seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+        scfg = ServeConfig(
+            config=os.environ.get("BENCH_SERVE_CONFIG", CONFIG),
+            n_shards=int(os.environ.get("BENCH_SERVE_SHARDS", "1")),
+            compute=DTYPE,
+            max_batch=int(os.environ.get("BENCH_SERVE_MAX_BATCH", "8")),
+            plan_path=PLAN_PATH,
+            supervise=os.environ.get("BENCH_SERVE_SUPERVISE", "0") != "0",
+            journal_path=journal_path,
+            model_cfg=model_cfg,
+        )
+        # Shed-by-class under saturation: the class mix's SLO policy IS
+        # the admission policy for the sweep (the whole point of pushing
+        # past capacity is to watch it shed attributably). The mix derives
+        # from the resolved bucket set, so resolve once, then build.
+        classes = list(default_class_mix(InferenceServer(scfg).buckets))
+        scfg = dataclasses.replace(scfg, slo=slo_policy(classes))
+        server = InferenceServer(scfg)
+        tracer = Tracer(journal=server.journal)
+        set_tracer(tracer)
+        try:
+            server.start()
+            try:
+                rows = saturation_sweep(
+                    server,
+                    rates,
+                    duration_s=float(os.environ.get("BENCH_SAT_DURATION", "2")),
+                    classes=classes,
+                    shape=os.environ.get("BENCH_SAT_SHAPE", "steady"),
+                    seed=seed,
+                    knee_factor=float(os.environ.get("BENCH_SAT_KNEE", "3.0")),
+                    journal_path=journal_path,
+                )
+            finally:
+                server.stop()
+        finally:
+            set_tracer(None)
+        for row in rows:
+            print(
+                json.dumps(
+                    {
+                        "metric": SATURATE_METRIC,
+                        "unit": "img/s",
+                        **row,
+                        "cache_misses_post_warmup": server.stats.cache_misses,
+                        "config": scfg.config,
+                        "shards": scfg.n_shards,
+                        "dtype": scfg.compute,
+                        "supervise": scfg.supervise,
+                        "buckets": list(server.buckets),
+                        "platform": platform,
+                        "journal": journal_path,
+                        "trace_id": tracer.trace_id,
+                    }
+                ),
+                flush=True,
+            )
+        return 0
+    except Exception as e:
+        return fail(f"{type(e).__name__}: {e}"[:200], platform)
+
+
 def _measure_once(configs=None) -> list:
     """One full probe+measure pass; returns the JSON row list to emit, one
     row per ``configs`` entry (default: the full BENCH_CONFIGS list; the
@@ -944,6 +1078,8 @@ def main() -> int:
     """
     if MODE == "serve":
         return _serve_main()
+    if MODE == "saturate":
+        return _saturate_main()
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,

@@ -54,6 +54,12 @@ _PIDS = {
 _KIND_PID = {
     "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
     "serve_miss": "serve", "serve_warm": "serve", "serve_rewarm": "serve",
+    # Network front end records (ISSUE 11, docs/SERVING.md "Network front
+    # end & SLOs") land on the serve lane: one serve_transport per HTTP
+    # exchange (span-correlated when traced — it pins ONTO its
+    # serve.transport span), one serve_reject per 429/413 refusal. Old
+    # journals without them export unchanged.
+    "serve_transport": "serve", "serve_reject": "serve",
     "sup_build": "sup", "sup_trip": "sup", "sup_degrade": "sup",
     "sup_ok": "sup", "sup_warm": "sup", "sup_reshard": "sup",
     "sup_replay": "sup", "sup_step": "sup", "mesh_shrink": "sup",
@@ -73,6 +79,9 @@ _KIND_DUR_FIELD = {
     "serve_batch": "batch_ms",
     "serve_warm": "ms",
     "serve_rewarm": "ms",
+    # An uncorrelated serve_transport (untraced run) still renders as a
+    # slice — its ms is the whole HTTP exchange.
+    "serve_transport": "ms",
     "sup_warm": "ms",
     # A committed promotion carries its wall ms (spot-check + reshard +
     # re-warm); a probation "pass" record carries the ms the device waited

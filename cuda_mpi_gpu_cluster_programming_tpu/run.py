@@ -195,6 +195,29 @@ def make_parser() -> argparse.ArgumentParser:
                    "sizes (overrides the powers-of-two/TunePlan-derived "
                    "set)")
     p.add_argument(
+        "--serve-frontend",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="with --serve: expose the service over HTTP on 127.0.0.1:PORT "
+        "(0 = ephemeral) and drive the load through a threaded HTTP client "
+        "fleet over real sockets instead of in-process submits "
+        "(docs/SERVING.md 'Network front end & SLOs'). Backpressure is "
+        "429, sheds are 504 with their reason, every exchange journals a "
+        "serve.transport span. Prints a machine-parsed 'Serve frontend:' "
+        "line",
+    )
+    p.add_argument(
+        "--traffic-shape",
+        default="",
+        help="with --serve: traffic-shaped load instead of plain Poisson — "
+        "steady | diurnal | burst | flash, composable with '+' (e.g. "
+        "'diurnal+burst'), params as key=value ('diurnal:amp=0.8,period=2"
+        "+burst:every=1,mult=5'). Requests draw a seeded heavy-tailed "
+        "class mix (interactive/batch/bulk) with per-class deadlines and "
+        "SLO-aware shed-by-class; prints per-class 'Serve class:' lines",
+    )
+    p.add_argument(
         "--trace",
         default="",
         help="journal spans (observability.trace) to this jsonl path: "
@@ -470,12 +493,23 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        from .serving.loadgen import run_load
+        from .serving.loadgen import run_load, run_shaped_load
         from .serving.server import InferenceServer, ServeConfig
+        from .serving.traffic import default_class_mix, parse_shape, slo_policy
 
         buckets = tuple(
             int(b) for b in args.serve_buckets.split(",") if b.strip()
         )
+        # Shaped traffic carries a class mix whose SLO policy rides into
+        # admission (shed-by-class); plain Poisson keeps PR 6 behavior.
+        mix = None
+        slo = None
+        if args.traffic_shape:
+            try:
+                parse_shape(args.traffic_shape)  # fail loudly before building
+            except ValueError as e:
+                print(f"--traffic-shape: {e}", file=sys.stderr)
+                return 2
         scfg = ServeConfig(
             config=args.config,
             n_shards=args.shards,
@@ -492,6 +526,12 @@ def main(argv=None) -> int:
             default_deadline_s=args.serve_deadline_s or None,
             model_cfg=blocks_cfg,
         )
+        if args.traffic_shape:
+            mix = list(default_class_mix(
+                InferenceServer(scfg, params=params, plan=plan).buckets
+            ))
+            slo = slo_policy(mix)
+            scfg = dataclasses.replace(scfg, slo=slo)
         server = InferenceServer(scfg, params=params, plan=plan)
         # With --trace the tracer is already installed; otherwise the
         # serve journal doubles as the span trail, so ONE file exports
@@ -502,28 +542,85 @@ def main(argv=None) -> int:
             serve_tracer = Tracer(journal=server.journal)
             set_tracer(serve_tracer)
             print(f"Trace: id={serve_tracer.trace_id} journal={scfg.journal_path}")
+        frontend = None
         try:
             server.start()
             try:
-                with obs_span(
-                    "serve.load",
-                    rate_rps=args.serve_rate,
-                    duration_s=args.serve_duration,
-                ):
-                    report = run_load(
-                        server,
+                if args.serve_frontend is not None:
+                    # The network path: requests travel a real socket into
+                    # the admission queue; the load is a threaded HTTP
+                    # client fleet (docs/SERVING.md).
+                    from .serving.frontend import ServingFrontend, http_fleet_load
+
+                    frontend = ServingFrontend(
+                        server, port=args.serve_frontend
+                    ).start()
+                    print(f"Serve frontend: url={frontend.url}")
+                    with obs_span(
+                        "serve.load",
                         rate_rps=args.serve_rate,
                         duration_s=args.serve_duration,
-                        seed=args.seed,
-                    )
+                        transport="http",
+                    ):
+                        report = http_fleet_load(
+                            frontend.url,
+                            (
+                                blocks_cfg.in_height,
+                                blocks_cfg.in_width,
+                                blocks_cfg.in_channels,
+                            ),
+                            shape=args.traffic_shape or "steady",
+                            rate_rps=args.serve_rate,
+                            duration_s=args.serve_duration,
+                            classes=mix or list(default_class_mix(server.buckets)),
+                            seed=args.seed,
+                        )
+                elif args.traffic_shape:
+                    with obs_span(
+                        "serve.load",
+                        rate_rps=args.serve_rate,
+                        duration_s=args.serve_duration,
+                        shape=args.traffic_shape,
+                    ):
+                        report = run_shaped_load(
+                            server,
+                            shape=args.traffic_shape,
+                            rate_rps=args.serve_rate,
+                            duration_s=args.serve_duration,
+                            classes=mix,
+                            seed=args.seed,
+                        )
+                else:
+                    with obs_span(
+                        "serve.load",
+                        rate_rps=args.serve_rate,
+                        duration_s=args.serve_duration,
+                    ):
+                        report = run_load(
+                            server,
+                            rate_rps=args.serve_rate,
+                            duration_s=args.serve_duration,
+                            seed=args.seed,
+                        )
             finally:
+                if frontend is not None:
+                    frontend.stop()
                 server.stop()
         finally:
             if serve_tracer is not None:
                 set_tracer(None)  # in-process callers must not leak a tracer
         print(f"Serve buckets: {','.join(str(b) for b in server.buckets)}")
         print(f"Serve load: {report.summary()}")
+        if hasattr(report, "class_lines"):
+            for line in report.class_lines():
+                print(line)
         print(f"Serve: {server.summary()}")
+        if frontend is not None:
+            codes = " ".join(
+                f"http_{c}={n}"
+                for c, n in sorted(frontend.http_codes.items())
+            )
+            print(f"Serve transport: {codes}")
         if server.sup is not None:
             # Same machine-parsed supervisor line as the one-shot
             # --supervise path (harness._RE_SUPERVISOR).

@@ -1,14 +1,18 @@
 """Continuous-batching inference service (docs/SERVING.md).
 
 The forward path grown into a serving loop: an admission queue with
-per-request deadlines (``queue``), bucketed batch assembly over a fixed
-padded-shape set so the persistent compile cache is hit, never missed
-(``batcher``), a dispatch loop wrapping ``configs.build_forward`` — or the
-PR 5 elastic supervisor as the in-service degradation ladder — that
-journals every batch (``server``), and a Poisson load generator with
-latency-percentile reporting (``loadgen``).
+per-request deadlines and class-aware SLO shedding (``queue``, ``slo``),
+bucketed batch assembly over a fixed padded-shape set so the persistent
+compile cache is hit, never missed (``batcher``), a dispatch loop
+wrapping ``configs.build_forward`` — or the PR 5 elastic supervisor as
+the in-service degradation ladder — that journals every batch
+(``server``), a load generator with Poisson AND traffic-shaped arrivals
+plus latency-percentile reporting and the saturation sweep (``loadgen``,
+``traffic``), and the HTTP network front end over the admission queue
+with its threaded client fleet (``frontend``).
 
-Layering rule: ``queue``/``batcher``/``loadgen`` are stdlib+numpy only (no
-jax import — the same rule as ``resilience.policy``); only ``server`` pays
-the backend import, at dispatch-build time.
+Layering rule: ``queue``/``batcher``/``loadgen``/``traffic``/``slo`` are
+stdlib+numpy only (no jax import — the same rule as
+``resilience.policy``); only ``server`` pays the backend import, at
+dispatch-build time, and ``frontend`` rides on ``server``.
 """

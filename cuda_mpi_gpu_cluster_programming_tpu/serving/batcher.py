@@ -114,7 +114,11 @@ class Batcher:
         latency/throughput trade is made by the bucket set, not a timer:
         a lone request dispatches immediately at bucket 1 instead of
         waiting for co-riders that may never come (deadline-aware: holding
-        it could expire it)."""
+        it could expire it). ``shed`` carries every request the queue
+        dropped on the way — hard-deadline expiries AND class-SLO
+        blow-outs when an :class:`~.slo.SLOPolicy` is installed
+        (``Request.shed_reason`` says which); the server journals each
+        one attributably."""
         if not len(self.queue):
             self.queue.wait_nonempty(wait_s)
         taken, shed = self.queue.pop_ready(self.max_batch)

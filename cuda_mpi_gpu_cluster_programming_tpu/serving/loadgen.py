@@ -28,6 +28,14 @@ import numpy as np
 
 from .queue import FAILED, OK, SHED, RequestHandle
 from .server import InferenceServer
+from .traffic import (
+    ClassStats,
+    RequestClass,
+    ShapedReport,
+    assign_classes,
+    default_class_mix,
+    shaped_arrivals,
+)
 
 
 def poisson_arrivals(
@@ -137,3 +145,215 @@ def run_load(
         duration_s=wall,
         latencies_ms=lat,
     )
+
+
+# ------------------------------------------------------- shaped traffic ---
+
+
+def run_shaped_load(
+    server: InferenceServer,
+    *,
+    shape: str = "steady",
+    rate_rps: float,
+    duration_s: float,
+    classes: Optional[List[RequestClass]] = None,
+    seed: int = 0,
+    wait_timeout_s: float = 120.0,
+) -> ShapedReport:
+    """Drive a started server with traffic-shaped, class-mixed load.
+
+    Arrivals come from :func:`~.traffic.shaped_arrivals` (diurnal ramps,
+    bursts, flash crowds — seeded, deterministic); each arrival draws a
+    seeded (class, n_images) assignment from the heavy-tailed mix
+    (default: :func:`~.traffic.default_class_mix` over the server's
+    bucket set) and submits with the class's own deadline. Every handle
+    is awaited (bounded), so per-class accounting CLOSES: ok + shed +
+    failed + rejected == offered for every class — the report's
+    ``closed`` property is the drill's acceptance check.
+    """
+    if classes is None:
+        classes = list(default_class_mix(server.buckets))
+    m = server._model_cfg()
+    imgs: dict = {}  # n_images -> cached input (allocation, not payload)
+
+    def _input(n: int) -> np.ndarray:
+        if n not in imgs:
+            imgs[n] = np.ones(
+                (n, m.in_height, m.in_width, m.in_channels), np.float32
+            )
+        return imgs[n]
+
+    arrivals = shaped_arrivals(shape, rate_rps, duration_s, seed)
+    plan = assign_classes(classes, len(arrivals), seed)
+    stats: dict = {c.name: ClassStats() for c in classes}
+    handles: List[tuple] = []  # (RequestClass, handle)
+    t0 = time.monotonic()
+    for (at, (c, n)) in zip(arrivals, plan):
+        now = time.monotonic() - t0
+        if at > now:
+            time.sleep(at - now)
+        st = stats[c.name]
+        st.offered += 1
+        try:
+            handles.append(
+                (c, server.submit(_input(n), deadline_s=c.deadline_s, cls=c.name))
+            )
+        except (ValueError, RuntimeError):
+            st.rejected += 1  # QueueFull/too-wide: backpressure, counted
+    wait_deadline = time.monotonic() + wait_timeout_s
+    for _c, h in handles:
+        h.wait(max(0.0, wait_deadline - time.monotonic()))
+    images_ok = 0
+    completed_at: List[float] = []
+    for c, h in handles:
+        st = stats[c.name]
+        if h.completed_at is not None:
+            completed_at.append(h.completed_at)
+        if h.status == OK:
+            st.ok += 1
+            st.images_ok += h.n_images
+            images_ok += h.n_images
+            if h.latency_ms is not None:
+                st.latencies_ms.append(h.latency_ms)
+        elif h.status == SHED:
+            st.shed += 1
+        else:
+            st.failed += 1
+    wall = (max(completed_at) - t0) if completed_at else (time.monotonic() - t0)
+    return ShapedReport(
+        shape=shape,
+        per_class=stats,
+        duration_s=wall,
+        sustained_img_s=images_ok / wall if wall > 0 else 0.0,
+    )
+
+
+# ------------------------------------------------------ saturation sweep ---
+
+
+def locate_knee(rows: List[dict], factor: float = 3.0) -> Optional[float]:
+    """The p99 knee of a saturation sweep: the first offered rate (img/s,
+    ascending) whose journal p99 exceeds ``factor`` x the lowest measured
+    rate's p99 — where the latency curve leaves its flat region and turns
+    vertical. None when every swept rate stayed under the threshold (the
+    sweep never crossed capacity — sweep higher)."""
+    measured = [
+        r for r in sorted(rows, key=lambda r: r["offered_img_s"])
+        if isinstance(r.get("p99_ms"), (int, float))
+    ]
+    if not measured:
+        return None
+    base = measured[0]["p99_ms"]
+    if base <= 0:
+        return None
+    for r in measured[1:]:
+        if r["p99_ms"] > factor * base:
+            return float(r["offered_img_s"])
+    return None
+
+
+def saturation_sweep(
+    server: InferenceServer,
+    rates_rps: List[float],
+    *,
+    duration_s: float,
+    classes: Optional[List[RequestClass]] = None,
+    shape: str = "steady",
+    seed: int = 0,
+    knee_factor: float = 3.0,
+    journal_path: str = "",
+) -> List[dict]:
+    """Sweep offered load past capacity on ONE started server; one row
+    dict per rate, each carrying the located ``knee_rate_img_s``.
+
+    Per rate: the metrics registry is reset (so its ``serve.request_ms``
+    percentiles cover exactly this rate's window), a shaped load runs,
+    and percentiles are computed BOTH from the journal slice this rate
+    appended and from the registry histogram — the same nearest-rank
+    estimator over the same population, so the row can assert they agree
+    (``percentiles_agree``). After the sweep the p99 knee is located
+    (:func:`locate_knee`) and stamped on every row.
+    """
+    from ..observability.metrics import registry as metrics_registry
+    from ..resilience.journal import Journal
+    from .server import class_latencies_from_records, latencies_from_records
+
+    if classes is None:
+        classes = list(default_class_mix(server.buckets))
+    rows: List[dict] = []
+    for rate in sorted(rates_rps):
+        n0 = len(Journal.load(journal_path)) if journal_path else 0
+        misses0 = server.stats.cache_misses
+        metrics_registry().reset()
+        report = run_shaped_load(
+            server, shape=shape, rate_rps=rate, duration_s=duration_s,
+            classes=classes, seed=seed,
+        )
+        # Quiesce before reading: a handle wakes its waiter BEFORE the
+        # dispatch thread's @off_timed_path completion helper finishes
+        # journaling the batch, so the last batch's records can lag the
+        # report by a scheduler slice. The rate's row must cover its whole
+        # population (and the registry must be settled before the next
+        # rate resets it) — poll, bounded.
+        recs: List[dict] = []
+        quiesce = time.monotonic() + 10.0
+        while journal_path:
+            recs = Journal.load(journal_path)[n0:]
+            if (
+                len(latencies_from_records(recs)) >= report.n_ok
+                or time.monotonic() >= quiesce
+            ):
+                break
+            time.sleep(0.01)
+        jlat = latencies_from_records(recs)
+        by_cls = class_latencies_from_records(recs)
+        reg_p99 = metrics_registry().histogram("serve.request_ms").percentile(99)
+        j_p99 = percentile(jlat, 99)
+        rows.append(
+            {
+                "rate_rps": rate,
+                "offered": report.n_requests,
+                "offered_img_s": round(rate * _mean_images(classes), 3),
+                "value": round(report.sustained_img_s, 1),
+                "p50_ms": percentile(jlat, 50),
+                "p99_ms": j_p99,
+                "metrics_p99_ms": reg_p99,
+                "percentiles_agree": (
+                    j_p99 is not None and reg_p99 is not None
+                    and abs(j_p99 - reg_p99) <= max(1e-6, 0.05 * j_p99)
+                ),
+                "classes": {
+                    (n or "default"): {
+                        **report.per_class[n].to_obj(),
+                        "journal_p99_ms": percentile(by_cls.get(n, []), 99),
+                    }
+                    for n in report.per_class
+                },
+                "n_ok": report.n_ok,
+                "n_shed": report.n_shed,
+                "n_failed": report.n_failed,
+                "n_rejected": report.n_rejected,
+                "accounting_closed": report.closed,
+                "cache_misses": server.stats.cache_misses - misses0,
+                "duration_s": round(report.duration_s, 3),
+                "shape": shape,
+                "seed": seed,
+            }
+        )
+    knee = locate_knee(rows, knee_factor)
+    for r in rows:
+        r["knee_rate_img_s"] = knee
+        r["knee_factor"] = knee_factor
+    return rows
+
+
+def _mean_images(classes: List[RequestClass]) -> float:
+    """Expected images per request under the mix — converts an arrival
+    rate (req/s) into offered load (img/s), the knee's unit."""
+    wsum = sum(c.weight for c in classes) or 1.0
+    total = 0.0
+    for c in classes:
+        szw = sum(c.size_weights) or 1.0
+        mean_sz = sum(s * w for s, w in zip(c.sizes, c.size_weights)) / szw
+        total += (c.weight / wsum) * mean_sz
+    return total

@@ -69,6 +69,10 @@ class ServeConfig:
     poll_s: float = 0.02
     default_deadline_s: Optional[float] = None
     model_cfg: Any = None  # Blocks12Config override (tests use 63x63)
+    # Optional serving.slo.SLOPolicy: per-class SLO targets with pop-time
+    # shed-by-class (docs/SERVING.md "Network front end & SLOs"). None =
+    # the PR 6 behavior (hard deadlines only).
+    slo: Any = None
 
 
 @dataclasses.dataclass
@@ -110,7 +114,7 @@ class InferenceServer:
         # exact rung a faulted run degraded to.
         self.cfg = cfg
         self._ladder = ladder
-        self.queue = AdmissionQueue(max_pending=cfg.max_pending)
+        self.queue = AdmissionQueue(max_pending=cfg.max_pending, slo=cfg.slo)
         self.stats = ServeStats()
         self.journal = Journal(cfg.journal_path) if cfg.journal_path else None
         self._plan = plan
@@ -296,11 +300,25 @@ class InferenceServer:
         # batch is even assembled, so in-flight requests are never dropped
         # and no post-promotion dispatch can miss the compile cache.
         self._maybe_promote()
+        self._observe_queue()
         batch, shed = self._batcher.next_batch(self.cfg.poll_s)
         if shed:
             self._record_shed(shed)
         if batch is not None:
             self._dispatch(batch)
+
+    @off_timed_path
+    def _observe_queue(self) -> None:
+        """Mirror the queue's saturation gauges into the metrics registry
+        between batches — ``serve.queue_oldest_wait_ms`` climbs toward the
+        tightest class SLO while every request is still servable, so
+        saturation is observable BEFORE the first shed (docs/SERVING.md).
+        O(1) per step; strictly off the dispatch timed region."""
+        qs = self.queue.stats()
+        reg = metrics_registry()
+        reg.gauge("serve.queue_depth").set(qs.depth)
+        reg.gauge("serve.queue_pending_images").set(qs.pending_images)
+        reg.gauge("serve.queue_oldest_wait_ms").set(qs.oldest_wait_ms)
 
     @off_timed_path
     def _maybe_promote(self) -> None:
@@ -361,14 +379,20 @@ class InferenceServer:
         on the dispatch path."""
         arr = np.asarray(out)
         lat_ms: Dict[str, float] = {}
+        req_cls: Dict[str, str] = {}
+        reg = metrics_registry()
         for req, off in batch.offsets():
             req.handle._complete(OK, arr[off : off + req.n_images])
             lat_ms[req.rid] = round(req.handle.latency_ms, 3)
+            req_cls[req.rid] = req.cls
+            # Per-request latency histogram: the SAME nearest-rank
+            # estimator and population as the journal-derived serve
+            # percentiles, so bench (registry) and journal p99s agree.
+            reg.histogram("serve.request_ms").observe(req.handle.latency_ms)
         self.stats.n_batches += 1
         self.stats.n_images += batch.n_images
         self.stats.n_ok += len(batch.requests)
         self.stats.batch_ms.append(batch_ms)
-        reg = metrics_registry()
         reg.counter("serve.ok").inc(len(batch.requests))
         reg.counter("serve.images").inc(batch.n_images)
         reg.histogram("serve.batch_ms").observe(batch_ms)
@@ -410,6 +434,7 @@ class InferenceServer:
             pad=batch.pad,
             batch_ms=round(batch_ms, 3),
             req_lat_ms=lat_ms,
+            req_cls=req_cls,
             entry=self.sup.entry.key if self.sup is not None else self.cfg.config,
             **trace_fields,
         )
@@ -417,11 +442,19 @@ class InferenceServer:
     @off_timed_path
     def _record_shed(self, shed: List[Request]) -> None:
         self.stats.n_shed += len(shed)
-        metrics_registry().counter("serve.shed").inc(len(shed))
+        reg = metrics_registry()
+        reg.counter("serve.shed").inc(len(shed))
         for req in shed:
+            reason = req.shed_reason or "deadline"
+            if reason == "slo":
+                # SLO sheds counted separately: "capacity protected the
+                # SLO" vs "a caller's own deadline lapsed" are different
+                # operational stories (docs/SERVING.md).
+                reg.counter("serve.shed_slo").inc()
             self._journal(
                 "serve_shed", key=f"shed:{req.rid}", rid=req.rid,
-                n_images=req.n_images,
+                n_images=req.n_images, cls=req.cls, reason=reason,
+                waited_ms=round(req.handle.latency_ms or 0.0, 3),
             )
 
     @off_timed_path
@@ -442,10 +475,17 @@ class InferenceServer:
     # ------------------------------------------------------------- frontend
 
     def submit(
-        self, x, *, deadline_s: Optional[float] = None, rid: Optional[str] = None
+        self,
+        x,
+        *,
+        deadline_s: Optional[float] = None,
+        rid: Optional[str] = None,
+        cls: str = "",
     ) -> RequestHandle:
         """Admit one request (thread-safe). Requests wider than the largest
-        bucket are rejected at the door — they could never dispatch."""
+        bucket are rejected at the door — they could never dispatch.
+        Deadline resolution: explicit ``deadline_s`` > the class's default
+        (SLO policy) > the server default."""
         x = np.asarray(x)
         n = 1 if x.ndim == 3 else int(x.shape[0])
         if n > self.buckets[-1]:
@@ -453,9 +493,11 @@ class InferenceServer:
                 f"request of {n} images exceeds the largest bucket "
                 f"{self.buckets[-1]} — split it client-side"
             )
+        if deadline_s is None and self.cfg.slo is not None:
+            deadline_s = self.cfg.slo.deadline_for(cls)
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
-        return self.queue.submit(x, deadline_s=deadline_s, rid=rid)
+        return self.queue.submit(x, deadline_s=deadline_s, rid=rid, cls=cls)
 
     def _journal(self, kind: str, key: str, **payload) -> None:
         if self.journal is not None:
@@ -481,8 +523,14 @@ def request_latencies_from_journal(path) -> List[float]:
     """All per-request latencies (ms) journaled by ``serve_batch`` records —
     the crash-consistent source the serve bench computes p50/p99 from (a
     killed run's percentiles cover exactly the requests that completed)."""
+    return latencies_from_records(Journal.load(path))
+
+
+def latencies_from_records(records: List[dict]) -> List[float]:
+    """Per-request latencies out of an already-loaded record list (the
+    saturation sweep slices ONE journal into per-rate windows)."""
     lats: List[float] = []
-    for rec in Journal.load(path):
+    for rec in records:
         if rec.get("kind") == "serve_batch":
             req_lat = rec.get("req_lat_ms")
             if isinstance(req_lat, dict):
@@ -491,3 +539,26 @@ def request_latencies_from_journal(path) -> List[float]:
                     if isinstance(v, (int, float))
                 )
     return lats
+
+
+def class_latencies_from_records(records: List[dict]) -> Dict[str, List[float]]:
+    """{class name: [latency ms, ...]} from ``serve_batch`` records — the
+    per-class p99 source (``req_cls`` maps each rid to its class; rids
+    journaled before the class field existed land under ``""``)."""
+    out: Dict[str, List[float]] = {}
+    for rec in records:
+        if rec.get("kind") != "serve_batch":
+            continue
+        req_lat = rec.get("req_lat_ms")
+        req_cls = rec.get("req_cls") or {}
+        if not isinstance(req_lat, dict):
+            continue
+        for rid, v in req_lat.items():
+            if isinstance(v, (int, float)):
+                out.setdefault(str(req_cls.get(rid, "")), []).append(float(v))
+    return out
+
+
+def class_latencies_from_journal(path) -> Dict[str, List[float]]:
+    """Journal-file form of :func:`class_latencies_from_records`."""
+    return class_latencies_from_records(Journal.load(path))

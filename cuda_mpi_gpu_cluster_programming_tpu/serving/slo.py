@@ -1,0 +1,106 @@
+"""SLO-aware admission: per-class latency targets and shed-by-class.
+
+PR 6's queue sheds by *age* alone: a request is dropped exactly when its
+hard deadline expires. Under saturation that policy is blind to what the
+request is — a bulk re-index job and an interactive query shed at the
+same age even though one has seconds of budget and the other milliseconds.
+This module adds the class dimension:
+
+- :class:`SLOClass` names a request class and its latency SLO
+  (``slo_ms`` — the p99 target the class is operated against) plus the
+  class's default hard deadline. ``shed_wait_ms`` (default: the SLO
+  itself) is the queue-wait point past which dispatching the request is
+  *wasted capacity*: it can no longer meet its SLO, and the batch slot it
+  would occupy pushes the next request over too.
+- :class:`SLOPolicy` is the queue's pop-time hook
+  (:meth:`AdmissionQueue.pop_ready`): ``should_shed(cls, waited_ms)``
+  returns ``"slo"`` when a request's wait has blown its class budget.
+  Because each class carries its own threshold, saturation sheds the
+  tight-SLO classes first while loose classes still complete — shed by
+  class, not by a single global age. Idle queues never trigger it (waits
+  stay near zero), so the policy costs nothing until the queue actually
+  saturates.
+
+Every policy shed completes the handle with status ``SHED`` and is
+journaled (``serve_shed`` with ``cls``/``reason="slo"``/``waited_ms``) —
+the same no-silent-loss contract as deadline shedding (``reason=
+"deadline"``).
+
+Stdlib only (no jax/numpy import) — the queue-layer rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+# The class name requests fall into when the submitter names none; its SLO
+# is unbounded so an un-classed service behaves exactly like PR 6.
+DEFAULT_CLASS = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One request class's operating targets."""
+
+    name: str
+    slo_ms: float  # p99 latency target (0 = unbounded: never SLO-shed)
+    deadline_s: Optional[float] = None  # class default hard deadline
+    # Queue-wait past which the request is shed as unservable within its
+    # SLO; defaults to slo_ms (a request that already waited its whole
+    # latency budget cannot meet it, dispatch time still to come).
+    shed_wait_ms: Optional[float] = None
+
+    @property
+    def shed_cut_ms(self) -> float:
+        cut = self.shed_wait_ms if self.shed_wait_ms is not None else self.slo_ms
+        return float(cut or 0.0)
+
+    def to_obj(self) -> dict:
+        return {
+            "name": self.name,
+            "slo_ms": self.slo_ms,
+            "deadline_s": self.deadline_s,
+            "shed_wait_ms": self.shed_cut_ms or None,
+        }
+
+
+class SLOPolicy:
+    """Per-class shed policy the queue consults at pop time.
+
+    Unknown class names resolve to ``default`` (unbounded unless given) —
+    a request the submitter never classified is served exactly like a
+    PR 6 request, never SLO-shed.
+    """
+
+    def __init__(
+        self,
+        classes: Sequence[SLOClass],
+        default: Optional[SLOClass] = None,
+    ):
+        self.classes: Dict[str, SLOClass] = {c.name: c for c in classes}
+        self.default = default or SLOClass(DEFAULT_CLASS, slo_ms=0.0)
+
+    def class_for(self, name: str) -> SLOClass:
+        return self.classes.get(name, self.default)
+
+    def deadline_for(self, name: str) -> Optional[float]:
+        """The class's default hard deadline (an explicit per-request
+        deadline always wins — resolution happens at submit)."""
+        return self.class_for(name).deadline_s
+
+    def should_shed(self, cls: str, waited_ms: float) -> Optional[str]:
+        """``"slo"`` when the request's queue wait has blown its class
+        budget (completing it would only burn a batch slot that pushes
+        the *next* request over), else None. Hard-deadline expiry is the
+        queue's own check, journaled ``reason="deadline"``."""
+        cut = self.class_for(cls).shed_cut_ms
+        if cut and waited_ms > cut:
+            return "slo"
+        return None
+
+    def to_obj(self) -> dict:
+        return {
+            "classes": [c.to_obj() for c in self.classes.values()],
+            "default": self.default.to_obj(),
+        }

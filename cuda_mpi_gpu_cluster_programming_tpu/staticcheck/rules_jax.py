@@ -39,6 +39,16 @@ gate that cries wolf gets ``# noqa``'d into uselessness.
                          (Tracer.emit takes explicit bounds for exactly
                          this). Same exemption mechanics as
                          host-sync-in-hot-loop.
+  blocking-socket-call-in-timed-region — recv/accept/connect/sendall/
+                         getresponse/urlopen inside a TIMED loop in the
+                         hot-loop scope (now including the serving front
+                         end + traffic/SLO layers): a network wait inside
+                         the region being measured corrupts the number
+                         and stalls the loop behind a peer's TCP window.
+                         Transport belongs on its own thread behind the
+                         admission queue. Same @off_timed_path exemption;
+                         a deliberate latency-measuring client loop
+                         carries a reviewed # noqa.
   key-reuse            — the same PRNG key expression consumed by two
                          jax.random draws with no intervening split/fold_in
                          rebinding (same scope), or a loop-invariant key
@@ -292,6 +302,11 @@ class UnreducedContractionRule(Rule):
 _HOT_LOOP_FILES = {
     "bench.py", "harness.py", "training.py", "run.py", "supervisor.py",
     "server.py", "loadgen.py", "batcher.py", "queue.py",
+    # The network serving front end + traffic/SLO layers (ISSUE 11): the
+    # transport sits directly on the request path, so a host sync or a
+    # blocking socket call inside a timed region there is a per-request
+    # latency tax.
+    "frontend.py", "traffic.py", "slo.py",
 }
 _HOT_LOOP_DIRS = {"observability"}
 
@@ -496,6 +511,80 @@ class SpanWriteInTimedRegionRule(Rule):
             return f"{recv or '<expr>'}.{attr}(...)"
         if attr == "append" and any(t in recv.lower() for t in _TRACERISH):
             return f"{recv}.append(...)"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# blocking-socket-call-in-timed-region
+
+
+# Socket primitives that block on the network. The attribute names are
+# distinctive enough to resolve statically without type inference
+# (``recv``/``accept``/``sendall``/``getresponse``/``urlopen``); generic
+# names (``read``, ``send``, ``request``) stay out — a rule that flags
+# queue ``request`` handling cries wolf and gets noqa'd into uselessness.
+_SOCKET_BLOCKING_ATTRS = {
+    "recv", "recv_into", "recvfrom", "recvmsg", "accept", "connect",
+    "sendall", "getresponse", "urlopen",
+}
+
+
+@register
+class BlockingSocketInTimedRegionRule(Rule):
+    """A blocking socket call inside a TIMED region (a for/while whose
+    body reads the clock) in the hot-loop scope: network waits inside the
+    region being measured corrupt the measurement AND stall the dispatch
+    loop behind a peer's TCP window. The serving front end keeps sockets
+    on transport threads — the dispatch loop never touches one — and the
+    client fleet's latency loop *deliberately* measures around its socket
+    (a reviewed ``# noqa: blocking-socket-call-in-timed-region``). The
+    ``@off_timed_path`` exemption applies, same mechanics as
+    host-sync-in-hot-loop."""
+
+    code = "blocking-socket-call-in-timed-region"
+
+    def applies(self, path: Path) -> bool:
+        return _in_hot_loop_scope(path)
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out = []
+        exempt = _off_timed_path_spans(ctx.tree)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            if not _loop_is_timed(loop):
+                continue
+            for node in _iter_loop_body(loop):
+                what = self._socket_kind(node)
+                if what is None:
+                    continue
+                if any(a <= node.lineno <= b for a, b in exempt):
+                    continue  # @off_timed_path: transport by contract
+                out.append(
+                    self.finding(
+                        ctx, node.lineno,
+                        f"{what} inside a timed region blocks on the "
+                        "network while the clock runs — move transport to "
+                        "its own thread, hand work through the admission "
+                        "queue, or mark the enclosing function "
+                        "@off_timed_path when it contractually runs "
+                        "between timed regions (a latency-measuring "
+                        "client loop carries a reviewed # noqa: "
+                        "blocking-socket-call-in-timed-region)",
+                        span=(node.lineno, getattr(node, "end_lineno", node.lineno)),
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _socket_kind(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SOCKET_BLOCKING_ATTRS:
+            return f"{_receiver_name(f) or '<expr>'}.{f.attr}(...)"
+        if isinstance(f, ast.Name) and f.id == "urlopen":
+            return "urlopen(...)"
         return None
 
 

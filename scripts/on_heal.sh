@@ -162,6 +162,39 @@ timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
     --out "logs/trace_serve_${FTS}.json" 2>&1 | tee -a "$LOG" \
     || say "serve trace export failed — see $LOG"
 
+say "saturation smoke (offered-load sweep past capacity on the CPU mesh — docs/SERVING.md 'Saturation study')"
+# The saturation study is PROVEN before chip time, same policy as the
+# serve smoke above: a seeded sweep past CPU-mesh capacity must LOCATE
+# the p99 knee (knee_rate_img_s non-null — the sweep actually crossed
+# capacity), close per-class accounting at every rate, agree between
+# journal and metrics-registry percentiles, and keep zero post-warmup
+# cache misses even while the queue saturates and sheds by class. A
+# sweep that can't find its own knee on an idle CPU cannot be trusted to
+# find the chip's.
+if timeout 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_MODE=saturate BENCH_SERVE_HEIGHT=63 BENCH_SERVE_WIDTH=63 \
+    BENCH_SERVE_MAX_BATCH=4 BENCH_SAT_RATES=30,120,600 \
+    BENCH_SAT_DURATION=1 \
+    BENCH_SERVE_JOURNAL=logs/saturate_smoke_${FTS}.jsonl \
+    python bench.py 2>>"$LOG" | tee -a "$LOG" \
+    | python -c "
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.startswith('{')]
+ok = bool(rows) and all(
+    not r.get('error')
+    and r.get('accounting_closed') is True
+    and r.get('cache_misses') == 0
+    and r.get('cache_misses_post_warmup') == 0
+    and r.get('percentiles_agree') is True
+    and r.get('knee_rate_img_s') is not None
+    for r in rows)
+sys.exit(0 if ok else 1)"; then
+    say "saturation smoke OK (p99 knee located, per-class accounting closed, journal==registry percentiles, zero cache misses; journal: logs/saturate_smoke_${FTS}.jsonl)"
+else
+    say "SATURATION SMOKE FAILED — saturation study broken; fix before trusting capacity numbers this window (journal: logs/saturate_smoke_${FTS}.jsonl)"
+fi
+
 # 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
 # timing once turned a ~30 s case into a 600 s timeout. If a test suite is
 # mid-flight when the window opens, wait it out (bounded) instead of

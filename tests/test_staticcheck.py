@@ -714,6 +714,7 @@ def test_cli_list_rules_has_all_new_codes():
         "collective-axis", "unreduced-contraction", "host-sync-in-hot-loop",
         "key-reuse", "jit-in-loop", "check-vma-disabled", "implicit-upcast",
         "stale-device-set", "span-write-in-timed-region",
+        "blocking-socket-call-in-timed-region",
         "raw-subprocess", "atomic-write", "variant-env", "deprecated",
     ):
         assert code in proc.stdout, code
@@ -819,3 +820,101 @@ def test_observability_scope_and_shipped_modules_clean():
         "bench.py",
     ):
         assert findings_for(ROOT / rel, "span-write-in-timed-region") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-socket-call-in-timed-region (ISSUE 11) + frontend hot-loop scope
+
+
+_SOCKET_SRC = (
+    "import time\n"
+    "def pump(sock, batches):\n"
+    "    for b in batches:\n"
+    "        t0 = time.perf_counter()\n"
+    "        data = sock.recv(4096)\n"  # line 5: flagged
+    "        ms = (time.perf_counter() - t0) * 1e3\n"
+    "    return ms\n"
+)
+
+
+def test_blocking_socket_in_timed_region_triggers(tmp_path):
+    """A socket recv inside a timed dispatch loop is flagged in a
+    hot-loop-scoped file (here: a frontend-named fixture)."""
+    p = tmp_path / "frontend.py"
+    p.write_text(_SOCKET_SRC)
+    found = findings_for(p, "blocking-socket-call-in-timed-region")
+    assert len(found) == 1 and found[0].line == 5
+    assert "off_timed_path" in found[0].message
+
+
+def test_blocking_socket_covers_client_calls(tmp_path):
+    p = tmp_path / "loadgen.py"
+    p.write_text(
+        "import time\n"
+        "from urllib.request import urlopen\n"
+        "def fleet(conn, urls):\n"
+        "    while urls:\n"
+        "        t0 = time.monotonic()\n"
+        "        conn.connect()\n"                 # line 6: flagged
+        "        resp = conn.getresponse()\n"      # line 7: flagged
+        "        urlopen(urls.pop())\n"            # line 8: flagged
+        "        dt = time.monotonic() - t0\n"
+    )
+    found = findings_for(p, "blocking-socket-call-in-timed-region")
+    assert sorted(f.line for f in found) == [6, 7, 8]
+
+
+def test_blocking_socket_untimed_loop_off_timed_path_and_noqa(tmp_path):
+    """Only TIMED regions are in scope; @off_timed_path transport helpers
+    are exempt by contract; a deliberate latency-measuring client loop
+    carries a reviewed # noqa."""
+    p = tmp_path / "frontend.py"
+    p.write_text(
+        "import time\n"
+        "def off_timed_path(fn):\n"
+        "    return fn\n"
+        "def drain(sock, batches):\n"
+        "    for b in batches:\n"          # no clock read: not a timed region
+        "        sock.sendall(b)\n"
+        "@off_timed_path\n"
+        "def transport(sock, batches):\n"
+        "    for b in batches:\n"
+        "        t0 = time.monotonic()\n"
+        "        sock.sendall(b)\n"
+        "        data = sock.recv(4096)\n"
+        "        dt = time.monotonic() - t0\n"
+    )
+    assert findings_for(p, "blocking-socket-call-in-timed-region") == []
+    q = tmp_path / "server.py"
+    q.write_text(
+        _SOCKET_SRC.replace(
+            ".recv(4096)\n",
+            ".recv(4096)  # noqa: blocking-socket-call-in-timed-region\n",
+        )
+    )
+    assert findings_for(q, "blocking-socket-call-in-timed-region") == []
+
+
+def test_blocking_socket_scope_and_shipped_serving_clean():
+    """ISSUE 11 satellite: the serving front end + traffic/SLO layers join
+    the hot-loop scope, and the shipped modules are clean under both the
+    host-sync and blocking-socket rules (the client fleet's one
+    deliberate socket wait carries its reviewed # noqa)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        BlockingSocketInTimedRegionRule,
+        HostSyncInHotLoopRule,
+    )
+
+    serving = "cuda_mpi_gpu_cluster_programming_tpu/serving"
+    for rule in (HostSyncInHotLoopRule(), BlockingSocketInTimedRegionRule()):
+        assert rule.applies(Path(f"{serving}/frontend.py"))
+        assert rule.applies(Path(f"{serving}/traffic.py"))
+        assert rule.applies(Path(f"{serving}/slo.py"))
+        assert not rule.applies(
+            Path("cuda_mpi_gpu_cluster_programming_tpu/analysis.py")
+        )
+    for mod in ("frontend.py", "traffic.py", "slo.py", "server.py", "loadgen.py"):
+        assert findings_for(ROOT / serving / mod, "host-sync-in-hot-loop") == []
+        assert findings_for(
+            ROOT / serving / mod, "blocking-socket-call-in-timed-region"
+        ) == []
