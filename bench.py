@@ -62,8 +62,19 @@ SERVE_METRIC = "alexnet_blocks12_serve_images_per_sec"
 # sweep offered load past capacity, one JSON row per rate with journal
 # AND metrics-registry percentiles (same estimator — they must agree)
 # and the located p99 knee (knee_rate_img_s) stamped on every row.
+# "replay" = the journal-replay fleet simulator (docs/OBSERVABILITY.md
+# "Replay & regression gating"): re-drive BENCH_REPLAY_JOURNAL through a
+# live server (same arrivals/classes/chaos schedule), optionally scaled
+# (BENCH_REPLAY_TRAFFIC_MULT / _DEVICES / _SLO_SCALE); one JSON row with
+# the per-class accounting diff and the divergence verdict. Exit 3 on a
+# neutral-replay divergence — the determinism contract, enforced.
+# "gate" = the BENCH_r*.json regression gate: one JSON row with the
+# structured verdict (>10% headline/stage regressions, last_good echoes
+# excluded attributably); exit 3 on any regression.
 MODE = os.environ.get("BENCH_MODE", "measure")
 SATURATE_METRIC = "alexnet_blocks12_serve_saturation"
+REPLAY_METRIC = "alexnet_blocks12_serve_replay"
+GATE_METRIC = "alexnet_blocks12_bench_gate"
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
 # Opt-in sweep: one JSON row per listed config (the V1->V5 story); unset =
@@ -958,6 +969,89 @@ def _saturate_main() -> int:
         return fail(f"{type(e).__name__}: {e}"[:200], platform)
 
 
+def _replay_main() -> int:
+    """BENCH_MODE=replay: re-drive a recorded serve journal through a
+    live server on this mesh and emit ONE JSON row — the replay's
+    per-class accounting against the record, both percentile pairs, and
+    the divergence verdict.
+
+    Tunables (env): BENCH_REPLAY_JOURNAL (required — the recorded
+    journal), BENCH_REPLAY_TRAFFIC_MULT (1.0), BENCH_REPLAY_DEVICES
+    (unset = recorded topology), BENCH_REPLAY_SLO_SCALE (1.0),
+    BENCH_REPLAY_OUT (the replay run's own journal; default temp).
+
+    Exit 0 with a parseable row; exit 2 (after the row) on an
+    unreplayable journal; exit 3 on a neutral-replay divergence — this
+    mode IS a gate, unlike the always-0 capture modes.
+    """
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
+
+    def fail(msg: str, platform: str = "unknown", rc: int = 2) -> int:
+        row = _error_obj(msg, platform)
+        row["metric"] = REPLAY_METRIC
+        print(json.dumps(row))
+        return rc
+
+    src = os.environ.get("BENCH_REPLAY_JOURNAL", "")
+    if not src:
+        return fail("BENCH_REPLAY_JOURNAL not set (the recorded journal)")
+    ok, info = probe(PROBE_TIMEOUT)
+    if not ok:
+        return fail(f"device {info}", rc=2)
+    platform = info
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.replay import (
+        ReplayKnobs,
+        load_recorded_run,
+        replay_recorded,
+    )
+
+    try:
+        recorded = load_recorded_run(src)
+    except ValueError as e:
+        return fail(f"unreplayable journal: {e}"[:300], platform)
+    devices = os.environ.get("BENCH_REPLAY_DEVICES", "")
+    try:
+        report = replay_recorded(
+            recorded,
+            ReplayKnobs(
+                traffic_mult=float(
+                    os.environ.get("BENCH_REPLAY_TRAFFIC_MULT", "1")
+                ),
+                devices=int(devices) if devices else None,
+                slo_scale=float(os.environ.get("BENCH_REPLAY_SLO_SCALE", "1")),
+                journal_path=os.environ.get("BENCH_REPLAY_OUT", ""),
+            ),
+        )
+    except Exception as e:
+        return fail(f"{type(e).__name__}: {e}"[:300], platform)
+    row = {"metric": REPLAY_METRIC, "unit": "img/s", **report.to_obj(),
+           "platform": platform}
+    print(json.dumps(row))
+    return 3 if report.diverged else 0
+
+
+def _gate_main() -> int:
+    """BENCH_MODE=gate: run the structured perf-regression gate over the
+    committed BENCH_r*.json trajectory (BENCH_GATE_PATHS overrides —
+    comma-separated) and emit ONE JSON row with the full verdict. Exit 3
+    on any surviving regression: perf claims fail CI, not scroll by."""
+    import glob
+
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.gate import (
+        evaluate,
+    )
+
+    spec = os.environ.get("BENCH_GATE_PATHS", "")
+    paths = (
+        [p for part in spec.split(",") if part.strip() for p in glob.glob(part.strip())]
+        if spec
+        else sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    )
+    verdict = evaluate(paths)
+    print(json.dumps({"metric": GATE_METRIC, **verdict.to_obj()}))
+    return 0 if verdict.ok else 3
+
+
 def _measure_once(configs=None) -> list:
     """One full probe+measure pass; returns the JSON row list to emit, one
     row per ``configs`` entry (default: the full BENCH_CONFIGS list; the
@@ -1080,6 +1174,10 @@ def main() -> int:
         return _serve_main()
     if MODE == "saturate":
         return _saturate_main()
+    if MODE == "replay":
+        return _replay_main()
+    if MODE == "gate":
+        return _gate_main()
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,

@@ -16,10 +16,23 @@ per-stage latency attribution, and Perfetto export (docs/OBSERVABILITY.md).
   (``serve_*``, ``sup_*``, ``gate_*``, ``mesh_shrink``, watchdog) into
   one Chrome trace-event / Perfetto JSON timeline, plus the cross-run
   BENCH_r*.json text report.
+- ``replay``  — the journal-replay fleet simulator: reconstruct a
+  recorded serve run's arrival schedule, request classes/deadlines, and
+  chaos schedule from its journal alone and re-drive it through a live
+  server on the CPU mesh, with ``traffic_mult``/``devices``/
+  ``slo_scale`` what-if knobs; a neutral replay must close per-class
+  accounting identically (the determinism contract).
+- ``gate``    — the structured BENCH_r*.json regression gate: >10%
+  headline/per-stage regressions fail (exit 3) with ``last_good``-echo
+  rounds excluded attributably.
 
 CLI: ``python -m cuda_mpi_gpu_cluster_programming_tpu.observability
-export --journal <dir|file> [--out trace.json]`` and
-``... report BENCH_r*.json``.
+export --journal <dir|file> [--out trace.json]``,
+``... replay --journal <dir|file> [--traffic-mult K] [--devices N]
+[--slo-scale F]``, and
+``... report [--fail-on-regression] [--json] BENCH_r*.json``
+(exit codes: 0 clean / 2 usage or unreplayable / 3 regression or
+replay divergence — docs/OBSERVABILITY.md).
 
 This package init re-exports only the import-light tracing/metrics
 surface (stdlib + journal — the wired subsystems pay no jax import);

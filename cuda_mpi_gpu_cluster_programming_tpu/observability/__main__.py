@@ -1,14 +1,28 @@
-"""CLI: export a Perfetto trace from journals; diff BENCH trajectories.
+"""CLI: Perfetto export, journal replay, and the BENCH regression gate.
 
     python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
         export --journal logs/serve_journal.jsonl --out logs/trace.json
     python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
-        report BENCH_r*.json
+        replay --journal logs/serve_journal.jsonl [--traffic-mult 2] \\
+        [--devices 1] [--slo-scale 0.5] [--journal-out replay.jsonl]
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
+        report [--fail-on-regression] [--json] BENCH_r*.json
+
+Exit codes (docs/OBSERVABILITY.md "Replay & regression gating"):
+
+- ``0`` — clean: trace exported / replay matched (or a what-if ran) /
+  no regression.
+- ``2`` — usage: missing journal, unreplayable journal (recorded before
+  the replay schema), bad arguments.
+- ``3`` — the gate tripped: a >10% regression with
+  ``--fail-on-regression``, or a NEUTRAL replay that broke the
+  determinism contract (per-class accounting or percentile divergence).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -37,10 +51,66 @@ def make_parser() -> argparse.ArgumentParser:
     )
     rp = sub.add_parser(
         "report",
-        help="cross-run text report diffing BENCH_r*.json trajectories "
-        "(flags >10% regressions)",
+        help="cross-run report diffing BENCH_r*.json trajectories "
+        "(>10% headline/stage regressions; last_good echoes excluded "
+        "attributably)",
     )
     rp.add_argument("bench", nargs="+", help="BENCH_r*.json paths")
+    rp.add_argument(
+        "--fail-on-regression",
+        action="store_true",
+        help="exit 3 when any >threshold regression survives echo "
+        "exclusion — the CI gate mode (tier-1 + on_heal.sh wiring)",
+    )
+    rp.add_argument(
+        "--json",
+        action="store_true",
+        help="print the machine-readable GateVerdict object instead of "
+        "the text report",
+    )
+    rl = sub.add_parser(
+        "replay",
+        help="re-drive a recorded serve journal through a live server on "
+        "the CPU mesh (same arrivals/classes/deadlines, same chaos "
+        "schedule) — scaling knobs turn it into a capacity what-if",
+    )
+    rl.add_argument(
+        "--journal",
+        required=True,
+        help="the recorded serve journal (.jsonl file or directory)",
+    )
+    rl.add_argument(
+        "--traffic-mult",
+        type=float,
+        default=1.0,
+        help="offer the recorded schedule at this multiple (2 = every "
+        "arrival twice; fractions select by a stable per-rid hash)",
+    )
+    rl.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        help="rebuild the server at this shard width instead of the "
+        "recorded one ('would it hold at half the devices?')",
+    )
+    rl.add_argument(
+        "--slo-scale",
+        type=float,
+        default=1.0,
+        help="scale every class SLO budget and per-request deadline "
+        "(0.5 = twice as tight)",
+    )
+    rl.add_argument(
+        "--journal-out",
+        default="",
+        help="journal the replay run here (default: a temp file; the "
+        "replay journal is itself replayable)",
+    )
+    rl.add_argument(
+        "--json",
+        action="store_true",
+        help="print the machine-readable replay report object",
+    )
     return p
 
 
@@ -70,9 +140,60 @@ def main(argv=None) -> int:
             )
         return 0
     if args.cmd == "report":
-        from .export import bench_report
+        from .gate import evaluate
 
-        print(bench_report(args.bench))
+        verdict = evaluate(args.bench)
+        if args.json:
+            print(json.dumps(verdict.to_obj()))
+        else:
+            print(verdict.render())
+        if args.fail_on_regression and not verdict.ok:
+            print(
+                f"regression gate: FAIL ({len(verdict.regressions)} "
+                f"regression(s) > {verdict.threshold:.0%})",
+                file=sys.stderr,
+            )
+            return 3
+        return 0
+    if args.cmd == "replay":
+        from .replay import ReplayKnobs, load_recorded_run, replay_recorded
+
+        src = Path(args.journal)
+        if not src.exists():
+            print(f"no journal at {src}", file=sys.stderr)
+            return 2
+        try:
+            recorded = load_recorded_run(src)
+        except ValueError as e:
+            print(f"unreplayable journal: {e}", file=sys.stderr)
+            return 2
+        if args.traffic_mult <= 0 or args.slo_scale <= 0:
+            print("--traffic-mult/--slo-scale must be > 0", file=sys.stderr)
+            return 2
+        report = replay_recorded(
+            recorded,
+            ReplayKnobs(
+                traffic_mult=args.traffic_mult,
+                devices=args.devices,
+                slo_scale=args.slo_scale,
+                journal_path=args.journal_out,
+            ),
+        )
+        if args.json:
+            print(json.dumps(report.to_obj()))
+        else:
+            print(f"Replay: {report.summary()}")
+            for line in report.class_lines():
+                print(line)
+        if report.diverged:
+            print(
+                "replay divergence: a neutral replay must reproduce the "
+                "recorded per-class accounting identically and land its "
+                "percentiles within estimator resolution "
+                "(docs/OBSERVABILITY.md)",
+                file=sys.stderr,
+            )
+            return 3
         return 0
     return 2
 

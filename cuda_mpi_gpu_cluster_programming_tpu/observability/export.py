@@ -25,18 +25,17 @@ Process rows group by subsystem (span-name prefix / record kind):
 serving, supervisor, tuning, train, journal. ``M`` metadata events name
 every pid/tid.
 
-Also here: :func:`bench_report`, the cross-run text diff of
-``BENCH_r*.json`` trajectories (value / per_pass_ms / per-stage
-breakdown), flagging >10% regressions between consecutive measured
-rounds — the attribution-aware replacement for eyeballing five JSON
-blobs.
+Also here: :func:`bench_report`, the text face of the cross-run
+``BENCH_r*.json`` regression gate (the structured verdict, echo
+exclusion, and the nonzero-exit CI wiring live in
+:mod:`..observability.gate`).
 """
 
 from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..resilience.journal import Journal, atomic_write_text
 
@@ -60,6 +59,12 @@ _KIND_PID = {
     # serve.transport span), one serve_reject per 429/413 refusal. Old
     # journals without them export unchanged.
     "serve_transport": "serve", "serve_reject": "serve",
+    # Replay-schema records (ISSUE 12, docs/OBSERVABILITY.md "Replay &
+    # regression gating"): the run-conditions header and the per-request
+    # arrival records land on the serve lane as instants, so an exported
+    # timeline shows the offered schedule beside its dispatches. Old
+    # journals without them export unchanged.
+    "serve_config": "serve", "serve_submit": "serve",
     "sup_build": "sup", "sup_trip": "sup", "sup_degrade": "sup",
     "sup_ok": "sup", "sup_warm": "sup", "sup_reshard": "sup",
     "sup_replay": "sup", "sup_step": "sup", "mesh_shrink": "sup",
@@ -257,108 +262,12 @@ def export_trace(journal_path, out_path) -> dict:
 # ------------------------------------------------------------ bench report
 
 
-def _bench_obj(path: Path) -> Optional[dict]:
-    """One BENCH_r*.json's measured row. The committed files are
-    driver-wrapped ({"parsed": {...}, "tail": ...}); bare row objects and
-    raw JSONL (first parseable line) are accepted too."""
-    try:
-        text = path.read_text()
-    except OSError:
-        return None
-    try:
-        obj = json.loads(text)
-    except ValueError:
-        for line in text.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    obj = json.loads(line)
-                    break
-                except ValueError:
-                    continue
-        else:
-            return None
-    if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
-        obj = obj["parsed"]
-    return obj if isinstance(obj, dict) else None
-
-
-def _row_value(row: dict) -> Tuple[Optional[float], str]:
-    """(throughput, provenance): a fresh value, the explicitly-stale
-    committed one, or nothing measurable."""
-    v = row.get("value")
-    if isinstance(v, (int, float)) and v > 0:
-        return float(v), "fresh"
-    lg = row.get("value_last_good")
-    if isinstance(lg, (int, float)) and lg > 0:
-        return float(lg), "last_good(stale)"
-    return None, "error" if row.get("error") else "none"
-
-
 def bench_report(paths) -> str:
     """Cross-run text report: the BENCH_r*.json trajectory with >10%
-    regressions between consecutive measured rounds flagged, plus
-    per-stage breakdown deltas where rounds carry the ``breakdown``
-    sub-object."""
-    rows: List[Tuple[str, dict]] = []
-    for p in sorted(Path(str(p)) for p in paths):
-        obj = _bench_obj(p)
-        if obj is not None:
-            rows.append((p.name, obj))
-    if not rows:
-        return "bench report: no parseable BENCH rows"
-    lines = ["bench trajectory:"]
-    prev_val: Optional[float] = None
-    prev_name = ""
-    prev_stages: Optional[Dict[str, float]] = None
-    regressions: List[str] = []
-    for name, row in rows:
-        val, prov = _row_value(row)
-        per_pass = row.get("per_pass_ms")
-        bits = [
-            f"  {name}:",
-            f"value={val:.1f} img/s" if val is not None else "value=unmeasured",
-            f"({prov})",
-        ]
-        if isinstance(per_pass, (int, float)):
-            bits.append(f"per_pass={per_pass:.3f} ms")
-        if row.get("error"):
-            bits.append(f"error={str(row['error'])[:60]!r}")
-        bd = row.get("breakdown")
-        stages = bd.get("stages") if isinstance(bd, dict) else None
-        if isinstance(stages, dict) and stages:
-            worst = max(stages, key=lambda s: stages[s])
-            bits.append(
-                f"breakdown[{len(stages)} stages, top {worst}="
-                f"{stages[worst]:.3f} ms]"
-            )
-            if prev_stages:
-                for s, ms in stages.items():
-                    p_ms = prev_stages.get(s)
-                    if (
-                        isinstance(p_ms, (int, float)) and p_ms > 0
-                        and ms > p_ms * 1.10
-                    ):
-                        regressions.append(
-                            f"  REGRESSION {name} stage {s}: "
-                            f"{p_ms:.3f} -> {ms:.3f} ms "
-                            f"(+{(ms / p_ms - 1) * 100:.0f}% vs {prev_name})"
-                        )
-            prev_stages = {
-                s: float(ms) for s, ms in stages.items()
-                if isinstance(ms, (int, float))
-            }
-        if val is not None and prev_val is not None and val < prev_val * 0.90:
-            regressions.append(
-                f"  REGRESSION {name}: {prev_val:.1f} -> {val:.1f} img/s "
-                f"(-{(1 - val / prev_val) * 100:.0f}% vs {prev_name})"
-            )
-        if val is not None:
-            prev_val, prev_name = val, name
-        lines.append(" ".join(bits))
-    if regressions:
-        lines.append("flags:")
-        lines.extend(regressions)
-    else:
-        lines.append("flags: none (no >10% regression between measured rounds)")
-    return "\n".join(lines)
+    regressions between consecutive measured rounds flagged (plus
+    per-stage breakdown deltas, with ``last_good``-echo rounds labeled
+    and excluded). The text face of :mod:`..observability.gate` — the
+    structured verdict (and the nonzero-exit CI gate) lives there."""
+    from .gate import evaluate
+
+    return evaluate(paths).render()

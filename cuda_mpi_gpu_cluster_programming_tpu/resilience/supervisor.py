@@ -68,7 +68,7 @@ import dataclasses
 import math
 import random
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -161,6 +161,23 @@ def _is_device_loss(e: BaseException) -> bool:
     )
 
 
+@dataclasses.dataclass
+class ScriptedFault:
+    """One deterministically scheduled device-loss incident
+    (:meth:`Supervisor.script_fault`): at supervised step ``step``, lose
+    exactly ``device_ids`` from the pool (empty = transient loss, no
+    topology change) and raise the device-loss signature so the ordinary
+    trip machinery recovers. The replay harness builds these from a
+    recorded journal's ``sup_trip``/``mesh_shrink`` records — same steps,
+    same victims, no seeded re-draw that could diverge from the record."""
+
+    step: int
+    kind: str = "device_loss"  # "device_loss" | "mesh_shrink"
+    device_ids: Tuple[int, ...] = ()
+    cause: str = "scripted"
+    fired: bool = False
+
+
 class Supervisor:
     """Wrap a degradation ladder of digest-tapped forwards with trip
     handling. ``execute(params, x)`` always returns the batch's output from
@@ -242,6 +259,11 @@ class Supervisor:
         # defers past it so a heal never lands inside the same step's
         # replay (drills stay deterministic step-for-step).
         self._rejoin_blocked_step: Optional[int] = None
+        # Scripted faults (observability.replay): deterministic re-drives
+        # of a RECORDED incident trail — unlike the seeded chaos sites,
+        # each entry names the exact step and device ids to lose, so a
+        # replayed run trips where the recorded run tripped.
+        self._scripted_faults: List[ScriptedFault] = []
 
     # ------------------------------------------------------------ building
 
@@ -401,6 +423,57 @@ class Supervisor:
         return ms
 
     # ----------------------------------------------------------- execution
+
+    def script_fault(
+        self,
+        step: int,
+        kind: str = "device_loss",
+        device_ids: Iterable[int] = (),
+        cause: str = "scripted",
+    ) -> ScriptedFault:
+        """Schedule a deterministic device-loss incident at supervised
+        step ``step`` — the replay harness's re-drive hook
+        (observability.replay, docs/OBSERVABILITY.md "Replay & regression
+        gating"). Unlike the seeded chaos sites this names the EXACT step
+        and victim ids a recorded run lost, so a replayed journal trips
+        where — and loses what — the record says it did. The fault rides
+        the ordinary trip path (``_trip_and_recover``): the replay run
+        journals the same ``mesh_shrink``/``sup_trip`` incident shape."""
+        f = ScriptedFault(
+            step=int(step), kind=kind, device_ids=tuple(device_ids), cause=cause
+        )
+        self._scripted_faults.append(f)
+        return f
+
+    def _maybe_scripted_fault(self, entry: LadderEntry) -> None:
+        for f in self._scripted_faults:
+            if f.fired or f.step != self._step:
+                continue
+            f.fired = True
+            lost: List[int] = []
+            if f.device_ids:
+                alive = {d.id for d in self.pool.alive()}
+                # Only ids still alive, and never the whole pool — the
+                # single-device floor needs somewhere to land, same rule
+                # as ElasticPool.lose itself.
+                lost = [i for i in f.device_ids if i in alive]
+                if len(lost) >= len(alive):
+                    lost = lost[: len(alive) - 1]
+                if lost:
+                    self.pool.lose(lost, cause=f.cause)
+            if f.kind == "mesh_shrink" and lost:
+                raise chaos.InjectedFault(
+                    "mesh_shrink",
+                    f"scripted ({f.cause}): lost {len(lost)} device(s) "
+                    f"{sorted(lost)}; entry {entry.key} mesh is stale — "
+                    f"{self.pool.n_alive} of {self.pool.n_total} devices "
+                    "survive",
+                )
+            raise chaos.InjectedFault(
+                "device_loss",
+                f"scripted ({f.cause}): entry {entry.key} needs "
+                f"{entry.n_shards} devices, have {max(entry.n_shards - 1, 0)}",
+            )
 
     def _maybe_chaos_device_loss(self, entry: LadderEntry) -> None:
         ch = chaos.active()
@@ -598,6 +671,7 @@ class Supervisor:
                 self._advance(f"build failed: {type(e).__name__}: {e}"[:200], e)
                 continue
             try:
+                self._maybe_scripted_fault(entry)
                 self._maybe_chaos_device_rejoin()
                 self._maybe_chaos_flap(entry)
                 self._maybe_chaos_mesh_shrink(entry)

@@ -218,6 +218,46 @@ def make_parser() -> argparse.ArgumentParser:
         "SLO-aware shed-by-class; prints per-class 'Serve class:' lines",
     )
     p.add_argument(
+        "--serve-replay",
+        default="",
+        metavar="JOURNAL",
+        help="re-drive a recorded serve journal through a live server on "
+        "this mesh (docs/OBSERVABILITY.md 'Replay & regression gating'): "
+        "same arrivals, request shapes/classes/deadlines, and chaos "
+        "schedule, reconstructed from the journal alone (--config et al. "
+        "are ignored — the journal's serve_config record is the truth). "
+        "Prints machine-parsed 'Replay:' and 'Replay class:' lines; rc 3 "
+        "when a neutral replay diverges from the recorded accounting, "
+        "rc 2 on an unreplayable (pre-replay-schema) journal",
+    )
+    p.add_argument(
+        "--replay-mult",
+        type=float,
+        default=1.0,
+        help="with --serve-replay: offer the recorded schedule at this "
+        "traffic multiple (what-if knob; non-neutral replays never rc 3)",
+    )
+    p.add_argument(
+        "--replay-devices",
+        type=int,
+        default=None,
+        help="with --serve-replay: rebuild the server at this shard "
+        "width instead of the recorded one",
+    )
+    p.add_argument(
+        "--replay-slo-scale",
+        type=float,
+        default=1.0,
+        help="with --serve-replay: scale every class SLO budget and "
+        "per-request deadline (0.5 = twice as tight)",
+    )
+    p.add_argument(
+        "--replay-journal",
+        default="",
+        help="with --serve-replay: journal the replay run here (itself "
+        "replayable; default: a temp file)",
+    )
+    p.add_argument(
         "--trace",
         default="",
         help="journal spans (observability.trace) to this jsonl path: "
@@ -294,6 +334,50 @@ def main(argv=None) -> int:
     if args.list_configs:
         for c in REGISTRY.values():
             print(f"{c.key:18s} {c.version_name:22s} {c.description}")
+        return 0
+
+    if args.serve_replay:
+        # Journal-replay mode: the recorded serve_config record carries
+        # the run's conditions, so every CLI build knob below is moot —
+        # rebuild from the journal, re-drive, judge.
+        from .observability.replay import (
+            ReplayKnobs,
+            load_recorded_run,
+            replay_recorded,
+        )
+
+        if args.replay_mult <= 0 or args.replay_slo_scale <= 0:
+            print(
+                "--replay-mult/--replay-slo-scale must be > 0",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            recorded = load_recorded_run(args.serve_replay)
+        except ValueError as e:
+            print(f"--serve-replay: {e}", file=sys.stderr)
+            return 2
+        report = replay_recorded(
+            recorded,
+            ReplayKnobs(
+                traffic_mult=args.replay_mult,
+                devices=args.replay_devices,
+                slo_scale=args.replay_slo_scale,
+                journal_path=args.replay_journal,
+            ),
+        )
+        print(f"Replay source: {args.serve_replay}")
+        print(f"Replay journal: {report.journal_path}")
+        print(f"Replay: {report.summary()}")
+        for line in report.class_lines():
+            print(line)
+        if report.diverged:
+            print(
+                "replay divergence: neutral replay broke the recorded "
+                "accounting/percentile contract (docs/OBSERVABILITY.md)",
+                file=sys.stderr,
+            )
+            return 3
         return 0
 
     if args.config not in REGISTRY:

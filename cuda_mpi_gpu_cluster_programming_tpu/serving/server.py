@@ -20,6 +20,15 @@ Dispatch discipline (docs/SERVING.md):
   get answers, late, instead of errors.
 - **Deadline-aware shedding.** Expired requests complete with status
   ``SHED`` at assembly time and are journaled — never silently dropped.
+- **Every run is replayable.** The journal carries the full arrival
+  schedule, not just the outcomes: one ``serve_config`` record at build
+  time (config / shards / buckets / SLO policy / model geometry) and one
+  ``serve_submit`` record per admission attempt (arrival offset, request
+  shape, class, resolved deadline, admitted-or-rejected). Together with
+  the ``sup_*``/``mesh_*`` incident records they are exactly what
+  ``observability.replay`` needs to re-drive the run — same arrivals,
+  same chaos schedule — on a live server (docs/OBSERVABILITY.md
+  "Replay & regression gating").
 
 The dispatch loop keeps host syncs out of its body (staticcheck's
 ``host-sync-in-hot-loop`` rule now covers this file): the timed region
@@ -42,7 +51,7 @@ from ..observability.trace import current_ids, get_tracer, span
 from ..resilience.journal import Journal
 from ..resilience.sentinel import off_timed_path
 from .batcher import AssembledBatch, Batcher, power_of_two_buckets
-from .queue import FAILED, OK, AdmissionQueue, Request, RequestHandle
+from .queue import FAILED, OK, AdmissionQueue, QueueFull, Request, RequestHandle
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,6 +134,12 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._started = False
+        # The journal epoch every serve_submit arrival offset is relative
+        # to; replay only needs the offsets' relative spacing, so the
+        # construction instant is as good an epoch as any.
+        self._epoch = time.monotonic()
+        self._seq_submit = 0
+        self._submit_lock = threading.Lock()  # submit() is thread-safe
         self.buckets = self._resolve_buckets()
         self._batcher = Batcher(self.queue, self.buckets)
 
@@ -263,7 +278,37 @@ class InferenceServer:
     def _ensure_built(self) -> None:
         if self._fwd is None and self.sup is None:
             self._build()
+            self._journal_config()
             self.warmup()
+
+    @off_timed_path
+    def _journal_config(self) -> None:
+        """One ``serve_config`` record per built server: the exact
+        conditions this run serves under, so a replay
+        (observability.replay) can rebuild an equivalent server from the
+        journal ALONE — config, topology, bucket set, SLO policy, model
+        geometry. Written before warmup so even a run killed mid-warm
+        leaves a replayable header."""
+        m = self._model_cfg()
+        cfg = self.cfg
+        self._journal(
+            "serve_config",
+            key="config",
+            config=cfg.config,
+            n_shards=cfg.n_shards,
+            compute=cfg.compute,
+            max_batch=cfg.max_batch,
+            buckets=list(self.buckets),
+            max_pending=cfg.max_pending,
+            poll_s=cfg.poll_s,
+            default_deadline_s=cfg.default_deadline_s,
+            supervise=cfg.supervise,
+            height=m.in_height,
+            width=m.in_width,
+            channels=m.in_channels,
+            slo=cfg.slo.to_obj() if cfg.slo is not None else None,
+            devices=self.sup.pool.n_alive if self.sup is not None else 1,
+        )
 
     def stop(self, drain: bool = True, timeout_s: float = 60.0) -> None:
         """Stop the dispatch thread; with ``drain`` (default) the loop
@@ -469,6 +514,10 @@ class InferenceServer:
             key=f"fail:{batch.seq}",
             bucket=batch.bucket,
             n_requests=len(batch.requests),
+            # Per-request class attribution, same shape as serve_batch's
+            # req_cls: a failed bulk batch and a failed interactive batch
+            # are different stories, and replay accounting closes per class.
+            req_cls={req.rid: req.cls for req in batch.requests},
             cause=cause,
         )
 
@@ -489,6 +538,7 @@ class InferenceServer:
         x = np.asarray(x)
         n = 1 if x.ndim == 3 else int(x.shape[0])
         if n > self.buckets[-1]:
+            self._journal_submit(rid or "", n, cls, None, "too_wide")
             raise ValueError(
                 f"request of {n} images exceeds the largest bucket "
                 f"{self.buckets[-1]} — split it client-side"
@@ -497,7 +547,51 @@ class InferenceServer:
             deadline_s = self.cfg.slo.deadline_for(cls)
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
-        return self.queue.submit(x, deadline_s=deadline_s, rid=rid, cls=cls)
+        try:
+            handle = self.queue.submit(x, deadline_s=deadline_s, rid=rid, cls=cls)
+        except QueueFull:
+            self._journal_submit(rid or "", n, cls, deadline_s, "queue_full")
+            raise
+        self._journal_submit(
+            handle.rid, n, cls, deadline_s, "", t=handle.submitted_at
+        )
+        return handle
+
+    def _journal_submit(
+        self,
+        rid: str,
+        n: int,
+        cls: str,
+        deadline_s: Optional[float],
+        reason: str,
+        t: Optional[float] = None,
+    ) -> None:
+        """One ``serve_submit`` record per admission attempt — the arrival
+        schedule half of the replay contract (``serve_config`` is the
+        conditions half). ``t_ms`` is the arrival offset from the server
+        epoch; rejected attempts (``admitted=False`` with their reason)
+        are recorded too, because a replayed load must OFFER them again
+        for per-class accounting to close identically. Runs on the
+        submitting thread, never the dispatch loop."""
+        if self.journal is None:
+            return
+        with self._submit_lock:  # HTTP handler threads submit concurrently
+            self._seq_submit += 1
+            self._journal(
+                "serve_submit",
+                key=f"sub:{self._seq_submit}",
+                rid=rid,
+                t_ms=round(
+                    ((t if t is not None else time.monotonic()) - self._epoch)
+                    * 1e3,
+                    3,
+                ),
+                n=n,
+                cls=cls,
+                deadline_s=deadline_s,
+                admitted=not reason,
+                reason=reason,
+            )
 
     def _journal(self, kind: str, key: str, **payload) -> None:
         if self.journal is not None:

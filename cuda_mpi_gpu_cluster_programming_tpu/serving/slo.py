@@ -64,6 +64,46 @@ class SLOClass:
             "shed_wait_ms": self.shed_cut_ms or None,
         }
 
+    @staticmethod
+    def from_obj(obj: dict) -> "SLOClass":
+        """Inverse of :meth:`to_obj` — the ``serve_config`` journal record
+        round-trip ``observability.replay`` rebuilds a recorded run's
+        admission policy from."""
+        return SLOClass(
+            name=str(obj.get("name", "")),
+            slo_ms=float(obj.get("slo_ms") or 0.0),
+            deadline_s=(
+                float(obj["deadline_s"])
+                if obj.get("deadline_s") is not None
+                else None
+            ),
+            shed_wait_ms=(
+                float(obj["shed_wait_ms"])
+                if obj.get("shed_wait_ms") is not None
+                else None
+            ),
+        )
+
+    def scaled(self, factor: float) -> "SLOClass":
+        """This class with every latency budget scaled by ``factor`` — the
+        replay harness's ``--slo-scale`` what-if knob (0.5 = 'would the
+        run hold with SLOs twice as tight?'). Unbounded budgets (0 /
+        None) stay unbounded: scaling cannot invent a ceiling."""
+        return SLOClass(
+            name=self.name,
+            slo_ms=self.slo_ms * factor if self.slo_ms else self.slo_ms,
+            deadline_s=(
+                self.deadline_s * factor
+                if self.deadline_s is not None
+                else None
+            ),
+            shed_wait_ms=(
+                self.shed_wait_ms * factor
+                if self.shed_wait_ms
+                else self.shed_wait_ms
+            ),
+        )
+
 
 class SLOPolicy:
     """Per-class shed policy the queue consults at pop time.
@@ -104,3 +144,22 @@ class SLOPolicy:
             "classes": [c.to_obj() for c in self.classes.values()],
             "default": self.default.to_obj(),
         }
+
+    @staticmethod
+    def from_obj(obj: dict) -> "SLOPolicy":
+        """Inverse of :meth:`to_obj` (the ``serve_config`` round-trip)."""
+        return SLOPolicy(
+            [SLOClass.from_obj(c) for c in obj.get("classes") or []],
+            default=(
+                SLOClass.from_obj(obj["default"])
+                if obj.get("default")
+                else None
+            ),
+        )
+
+    def scaled(self, factor: float) -> "SLOPolicy":
+        """Every class budget scaled by ``factor`` (replay ``--slo-scale``)."""
+        return SLOPolicy(
+            [c.scaled(factor) for c in self.classes.values()],
+            default=self.default.scaled(factor),
+        )

@@ -296,9 +296,12 @@ class UnreducedContractionRule(Rule):
 # request, so serving/{server,loadgen,batcher,queue}.py live under the
 # same rule (journal writes and result slicing are exempted via the same
 # @off_timed_path contract the supervisor's screening uses). The
-# observability subsystem (trace/metrics/stages/export) lives here too —
-# an instrumentation layer that syncs inside the loops it instruments
-# would corrupt every number it reports.
+# observability subsystem lives here too — an instrumentation layer that
+# syncs inside the loops it instruments would corrupt every number it
+# reports. Directory scope, so it covers trace/metrics/stages/export AND
+# the ISSUE 12 replay/gate modules: the replay pacing loop re-drives a
+# recorded arrival schedule on the wall clock, where a stray sync or
+# span write would shear the very schedule being reproduced.
 _HOT_LOOP_FILES = {
     "bench.py", "harness.py", "training.py", "run.py", "supervisor.py",
     "server.py", "loadgen.py", "batcher.py", "queue.py",

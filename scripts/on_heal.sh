@@ -195,6 +195,47 @@ else
     say "SATURATION SMOKE FAILED — saturation study broken; fix before trusting capacity numbers this window (journal: logs/saturate_smoke_${FTS}.jsonl)"
 fi
 
+say "journal-replay smoke (re-drive the serve smoke's journal on the CPU mesh — docs/OBSERVABILITY.md 'Replay & regression gating')"
+# The replay determinism contract is PROVEN before chip time: replaying
+# the serve smoke's own journal at neutral knobs must close per-class
+# accounting identically (rc 3 = divergence, rc 2 = the journal predates
+# the replay schema — both block trusting any replay what-if this
+# window). A 2x-traffic what-if row follows for the log: the capacity
+# question replay exists to answer without a chip window.
+timeout 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    replay --journal "logs/serve_smoke_${FTS}.jsonl" \
+    --journal-out "logs/replay_smoke_${FTS}.jsonl" 2>>"$LOG" \
+    | tee -a "$LOG"
+REPLAY_RC=${PIPESTATUS[0]}   # no pipefail here: tee must not mask rc 2/3
+if [ "$REPLAY_RC" = 0 ]; then
+    say "replay smoke OK (neutral replay reproduced the recorded per-class accounting; journal: logs/replay_smoke_${FTS}.jsonl)"
+    timeout 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+        replay --journal "logs/serve_smoke_${FTS}.jsonl" \
+        --traffic-mult 2 2>>"$LOG" \
+        | sed 's/^/whatif-2x /' | tee -a "$LOG" \
+        || say "2x what-if replay failed — see $LOG (non-gating: the neutral contract above holds)"
+else
+    say "REPLAY SMOKE FAILED (rc=$REPLAY_RC) — journal replay diverged (rc 3) or journal unreplayable (rc 2); fix before trusting capacity what-ifs this window"
+fi
+
+say "perf-regression gate over the committed BENCH trajectory (echo-aware; a >10% surviving regression blocks the window)"
+# The gate that turns bench_report from a viewer into CI: last_good
+# echoes are excluded attributably (the r02-r05 wedge trail), and any
+# surviving >10% headline/stage regression exits 3 — a window that
+# STARTS regressed should fix that first, not capture on top of it.
+timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    report --fail-on-regression BENCH_r*.json 2>>"$LOG" | tee -a "$LOG"
+GATE_RC=${PIPESTATUS[0]}
+if [ "$GATE_RC" = 0 ]; then
+    say "regression gate OK (no >10% regression between measured rounds; echoes excluded attributably)"
+else
+    say "REGRESSION GATE FAILED (rc=$GATE_RC) — a >10% regression survives echo exclusion; judge it before capturing new rounds (python -m cuda_mpi_gpu_cluster_programming_tpu.observability report BENCH_r*.json)"
+fi
+
 # 1-core VM (docs/ROUND5_NOTES.md): a pytest run concurrent with chip
 # timing once turned a ~30 s case into a 600 s timeout. If a test suite is
 # mid-flight when the window opens, wait it out (bounded) instead of

@@ -809,7 +809,15 @@ def test_observability_scope_and_shipped_modules_clean():
         assert not rule.applies(
             Path("cuda_mpi_gpu_cluster_programming_tpu/analysis.py")
         )
-    for mod in ("trace.py", "metrics.py", "stages.py", "export.py"):
+    # ISSUE 12: the directory scope grows with the subsystem — the replay
+    # pacing loop (a timed loop re-driving a recorded arrival schedule)
+    # and the gate are covered the moment they exist, and ship clean.
+    for mod in (
+        "trace.py", "metrics.py", "stages.py", "export.py",
+        "replay.py", "gate.py",
+    ):
+        for rule in (HostSyncInHotLoopRule(), SpanWriteInTimedRegionRule()):
+            assert rule.applies(Path(f"{obs}/{mod}"))
         assert findings_for(ROOT / obs / mod, "host-sync-in-hot-loop") == []
         assert findings_for(ROOT / obs / mod, "span-write-in-timed-region") == []
     # the wired hot paths stay clean too (persistence lives in
