@@ -103,33 +103,26 @@ REPEATS = int(os.environ.get("BENCH_REPEATS", "200"))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 BENCH_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", "900"))
 
-# bf16 MXU peak TFLOP/s by TPU generation (public spec sheets), matched
-# against jax's device_kind string. fp32 runs are also judged against the
-# bf16 peak (conservative: the real fp32 ceiling is lower, so true fp32 MFU
-# is higher). BENCH_PEAK_TFLOPS overrides; the assumed peak is emitted in
-# the JSON so the ratio is auditable.
-_PEAK_TABLE = [
-    ("v6", 918.0),  # v6e / Trillium
-    ("v5p", 459.0),
-    ("v5", 197.0),  # v5e — device_kind here reports "TPU v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
+ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, ROOT)
+
+# Device capability (peaks + HBM bandwidth) lives in ONE table now:
+# observability/specs.py (ISSUE 13) — bench delegates so the headline's
+# assumed peak and the roofline layer's verdicts can never disagree.
+# fp32 runs are still judged against the bf16 peak (conservative: the
+# real fp32 ceiling is lower, so true fp32 MFU is higher);
+# BENCH_PEAK_TFLOPS still overrides, and the assumed peak is still
+# emitted in the JSON so the ratio is auditable.
+from cuda_mpi_gpu_cluster_programming_tpu.observability.specs import (  # noqa: E402
+    bf16_peak_table,
+    peak_tflops as _peak_tflops_spec,
+)
+
+_PEAK_TABLE = bf16_peak_table()  # the historical name, same (marker, peak) shape
 
 
 def peak_tflops(device_kind: str) -> float:
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env)
-    kind = device_kind.lower()
-    for marker, peak in _PEAK_TABLE:
-        if marker in kind:
-            return peak
-    return 197.0  # unknown kind: assume the chip we actually develop on
-
-ROOT = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, ROOT)
+    return _peak_tflops_spec(device_kind, dtype="bf16")
 
 
 def _error_obj(msg: str, platform: str = "unknown", config: str = None) -> dict:
@@ -221,6 +214,41 @@ def _stage_breakdown(tier: str, dtype: str, params, x, platform: str,
             compute=dtype,
             repeats=int(os.environ.get("BENCH_BREAKDOWN_REPEATS", "3")),
             warmup=1,
+        ).to_obj()
+    except Exception as e:  # evidence, not the headline — degrade visibly
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _roofline_obj(breakdown: dict, dtype: str, device_kind: str = "",
+                  model_cfg=None) -> dict:
+    """The ``roofline`` sub-object beside ``breakdown`` (docs/
+    OBSERVABILITY.md "Roofline attribution"): the measured per-stage ms
+    joined with the analytic FLOP/byte ledger and the device spec into
+    per-stage MFU, achieved GB/s, compute/memory-bound verdicts and the
+    predicted fused-block ceiling. Degrades to a visible note, never a
+    mislabeled number — a skipped breakdown skips the join too."""
+    if not isinstance(breakdown, dict) or "stages" not in breakdown:
+        note = breakdown.get("skipped") or breakdown.get("error") if (
+            isinstance(breakdown, dict)
+        ) else None
+        return {"skipped": f"no per-stage breakdown to join ({note})"}
+    try:
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.roofline import (
+            attribute_roofline,
+        )
+
+        if not device_kind:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        return attribute_roofline(
+            breakdown["stages"],
+            dtype=dtype,
+            batch=int(breakdown.get("batch") or 1),
+            device_kind=device_kind,
+            cfg=model_cfg,
+            source="breakdown",
+            total_ms=breakdown.get("total_ms"),
         ).to_obj()
     except Exception as e:  # evidence, not the headline — degrade visibly
         return {"error": f"{type(e).__name__}: {e}"[:200]}
@@ -378,6 +406,12 @@ def _child() -> int:
             # paper's tables report, machine-comparable across BENCH_r*.
             out["breakdown"] = _stage_breakdown(
                 REGISTRY[cfg_key].tier, DTYPE, params, x, platform
+            )
+            # ... and the roofline join (ISSUE 13): per-stage MFU /
+            # achieved GB/s / bound verdicts + the predicted fused-block
+            # ceiling, from the same breakdown and the one spec table.
+            out["roofline"] = _roofline_obj(
+                out["breakdown"], DTYPE, device.device_kind
             )
         if plan is not None:
             # Tuned-vs-default on the SAME estimator: the headline row above
@@ -815,6 +849,12 @@ def _serve_main() -> int:
                 init_params_deterministic(model_cfg),
                 deterministic_input(bucket, model_cfg),
                 platform, model_cfg=model_cfg,
+            )
+            # The serve row's roofline join (ISSUE 13), at the bucket the
+            # service actually dispatches — same sub-object as measure
+            # rows, geometry-aware via model_cfg.
+            row["roofline"] = _roofline_obj(
+                row["breakdown"], scfg.compute, model_cfg=model_cfg
             )
         # The process-wide metrics registry the serving layer records into
         # (docs/OBSERVABILITY.md): counters + nearest-rank histogram

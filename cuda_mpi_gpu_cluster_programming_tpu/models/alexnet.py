@@ -108,6 +108,29 @@ def output_shape(cfg: Blocks12Config = BLOCKS12) -> Tuple[int, int, int]:
     return dims
 
 
+def stage_flops(cfg: Blocks12Config = BLOCKS12):
+    """Per-stage ``(name, flops, matmul_flops)`` for ONE image — the
+    stage-level FLOP ledger shared by :func:`flops_per_image`,
+    :func:`matmul_flops_per_image` and the roofline attribution layer
+    (``observability.roofline``). Both totals sum over this generator, so
+    a per-stage ledger and the whole-pass count can never drift apart.
+
+    ``flops`` counts everything (conv MACs x2 + bias + ReLU, pool window
+    compares, LRN window sums/scale); ``matmul_flops`` counts only the
+    MXU work (conv MACs x2) — the conventional MFU numerator.
+    """
+    for name, spec, (_hi, _wi, c_in), (h, w, c_out) in layer_dims(cfg):
+        if isinstance(spec, ConvSpec):
+            macs = h * w * c_out * spec.filter_size**2 * c_in
+            yield name, 2 * macs + h * w * c_out, 2 * macs  # +bias, +ReLU
+        elif isinstance(spec, PoolSpec):
+            yield name, h * w * c_out * spec.window**2, 0  # max compares
+        elif isinstance(spec, LrnSpec):
+            # per element: ~size multiplies + adds for the window sum, plus
+            # the scale power and divide
+            yield name, h * w * c_out * (2 * spec.size + 2), 0
+
+
 def flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
     """Exact FLOPs for one image through Blocks 1-2 (MAC = 2 FLOPs).
 
@@ -116,18 +139,7 @@ def flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
     "~0.33 GFLOPs" for the same workload; that figure undercounts (it is not
     reproducible from the layer dims), so we derive from the config instead.
     """
-    total = 0
-    for _name, spec, (_hi, _wi, c_in), (h, w, c_out) in layer_dims(cfg):
-        if isinstance(spec, ConvSpec):
-            macs = h * w * c_out * spec.filter_size**2 * c_in
-            total += 2 * macs + h * w * c_out  # +bias add, +ReLU
-        elif isinstance(spec, PoolSpec):
-            total += h * w * c_out * spec.window**2  # window max compares
-        elif isinstance(spec, LrnSpec):
-            # per element: ~size multiplies + adds for the window sum, plus
-            # the scale power and divide
-            total += h * w * c_out * (2 * spec.size + 2)
-    return total
+    return sum(f for _name, f, _mm in stage_flops(cfg))
 
 
 def matmul_flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
@@ -137,11 +149,7 @@ def matmul_flops_per_image(cfg: Blocks12Config = BLOCKS12) -> int:
     compares, LRN window sums, bias adds and ReLU are excluded —
     ``flops_per_image`` keeps the all-in count for throughput accounting.
     """
-    total = 0
-    for _name, spec, (_hi, _wi, c_in), (h, w, c_out) in layer_dims(cfg):
-        if isinstance(spec, ConvSpec):
-            total += 2 * h * w * c_out * spec.filter_size**2 * c_in
-    return total
+    return sum(mm for _name, _f, mm in stage_flops(cfg))
 
 
 def forward_blocks12(params: Params, x: jax.Array, cfg: Blocks12Config = BLOCKS12) -> jax.Array:

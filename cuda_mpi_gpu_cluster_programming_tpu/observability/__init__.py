@@ -25,12 +25,21 @@ per-stage latency attribution, and Perfetto export (docs/OBSERVABILITY.md).
 - ``gate``    — the structured BENCH_r*.json regression gate: >10%
   headline/per-stage regressions fail (exit 3) with ``last_good``-echo
   rounds excluded attributably.
+- ``specs``   — the ONE device spec table (peak TFLOP/s per dtype + HBM
+  GB/s per TPU generation; ``bench.peak_tflops`` delegates here) plus
+  the live ``device_memory_stats`` snapshot helper.
+- ``roofline`` — per-stage MFU / HBM-traffic attribution: the analytic
+  FLOP+byte ledger from ``models.alexnet``, the staged-vs-fused byte
+  model predicting each block's fused time floor and MFU ceiling (the
+  ROADMAP-1 megakernel judge), and the measured join emitting
+  compute/memory-bound verdicts with headroom.
 
 CLI: ``python -m cuda_mpi_gpu_cluster_programming_tpu.observability
 export --journal <dir|file> [--out trace.json]``,
 ``... replay --journal <dir|file> [--traffic-mult K] [--devices N]
-[--slo-scale F]``, and
-``... report [--fail-on-regression] [--json] BENCH_r*.json``
+[--slo-scale F]``,
+``... report [--fail-on-regression] [--json] BENCH_r*.json``, and
+``... roofline [BENCH_r*.json] [--live]``
 (exit codes: 0 clean / 2 usage or unreplayable / 3 regression or
 replay divergence — docs/OBSERVABILITY.md).
 

@@ -1,4 +1,5 @@
-"""CLI: Perfetto export, journal replay, and the BENCH regression gate.
+"""CLI: Perfetto export, journal replay, the BENCH regression gate, and
+roofline attribution.
 
     python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
         export --journal logs/serve_journal.jsonl --out logs/trace.json
@@ -7,13 +8,18 @@
         [--devices 1] [--slo-scale 0.5] [--journal-out replay.jsonl]
     python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
         report [--fail-on-regression] [--json] BENCH_r*.json
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
+        roofline BENCH_r*.json            # committed rows, echo-aware
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
+        roofline --live [--batch N] [--height H --width W]  # measure now
 
-Exit codes (docs/OBSERVABILITY.md "Replay & regression gating"):
+Exit codes (docs/OBSERVABILITY.md "Replay & regression gating" /
+"Roofline attribution"):
 
 - ``0`` — clean: trace exported / replay matched (or a what-if ran) /
-  no regression.
+  no regression / roofline rendered.
 - ``2`` — usage: missing journal, unreplayable journal (recorded before
-  the replay schema), bad arguments.
+  the replay schema), bad arguments, no measurable roofline view.
 - ``3`` — the gate tripped: a >10% regression with
   ``--fail-on-regression``, or a NEUTRAL replay that broke the
   determinism contract (per-class accounting or percentile divergence).
@@ -111,6 +117,44 @@ def make_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print the machine-readable replay report object",
     )
+    rf = sub.add_parser(
+        "roofline",
+        help="per-stage MFU / HBM-bandwidth attribution with "
+        "compute-vs-memory-bound verdicts and the predicted fused-block "
+        "ceiling, over committed BENCH_r*.json rows (echo-aware) or a "
+        "live measurement",
+    )
+    rf.add_argument(
+        "bench",
+        nargs="*",
+        help="BENCH_r*.json rows (driver-wrapped, bare objects, or "
+        "JSONL); last_good echoes are marked via the gate's detection "
+        "and never ranked as fresh",
+    )
+    rf.add_argument(
+        "--live",
+        action="store_true",
+        help="measure a per-stage breakdown NOW (observability.stages on "
+        "the current backend) and attribute it — CPU runs are judged "
+        "against an assumed spec, and say so",
+    )
+    rf.add_argument("--batch", type=int, default=4, help="live batch size")
+    rf.add_argument(
+        "--height", type=int, default=227, help="live input height"
+    )
+    rf.add_argument("--width", type=int, default=227, help="live input width")
+    rf.add_argument(
+        "--dtype", default="fp32", help="live dtype policy (fp32|bf16)"
+    )
+    rf.add_argument(
+        "--repeats", type=int, default=3, help="live per-prefix chain size"
+    )
+    rf.add_argument(
+        "--json",
+        action="store_true",
+        help="print machine-readable RooflineReport objects (one JSON "
+        "line per view)",
+    )
     return p
 
 
@@ -195,7 +239,111 @@ def main(argv=None) -> int:
             )
             return 3
         return 0
+    if args.cmd == "roofline":
+        return _roofline_main(args)
     return 2
+
+
+def _roofline_main(args) -> int:
+    """``roofline`` subcommand: ranked per-stage tables over committed
+    BENCH rows (gate-classified, echoes marked and never ranked as
+    fresh) or a live breakdown measurement."""
+    rendered = 0
+    # Row-per-line artifacts (perf/bench_tuned_*.jsonl — one row PER
+    # config) render every row; round files go through the gate's
+    # classifier so echoes are marked.
+    jsonl = [p for p in args.bench if str(p).endswith(".jsonl")]
+    rounds_paths = [p for p in args.bench if p not in jsonl]
+    for path in jsonl:
+        try:
+            lines = Path(path).read_text().splitlines()
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        from .roofline import roofline_from_bench_row
+
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            for rep in roofline_from_bench_row(obj):
+                rendered += 1
+                rep.label = f"{obj.get('config', '')} {rep.label}".strip()
+                if args.json:
+                    print(json.dumps({"row": f"{path}:{i + 1}", **rep.to_obj()}))
+                else:
+                    print(f"== {path}:{i + 1}")
+                    print(rep.render())
+    if rounds_paths:
+        from .gate import load_rounds
+        from .roofline import roofline_from_bench_row
+
+        rounds = load_rounds(rounds_paths)
+        if not rounds:
+            print("no parseable BENCH rows", file=sys.stderr)
+            return 2
+        for rr in rounds:
+            print(f"== {rr.name}: {rr.provenance}")
+            if rr.is_echo:
+                # The gate's echo detection, reused: a wedged round
+                # re-reporting an earlier round's number is marked and
+                # skipped — ranking it would double-count stale evidence.
+                print(
+                    f"   echo of {rr.echo_of} — stale carry, not ranked"
+                )
+                continue
+            reports = roofline_from_bench_row(rr.row)
+            if not reports:
+                print("   no measurable roofline view (error-only round)")
+                continue
+            for rep in reports:
+                rendered += 1
+                if args.json:
+                    print(json.dumps({"round": rr.name, **rep.to_obj()}))
+                else:
+                    print(rep.render())
+    if args.live:
+        import jax
+
+        from ..models.alexnet import BLOCKS12
+        from ..models.init import deterministic_input, init_params_deterministic
+        from .roofline import attribute_roofline
+        from .stages import attribute_stages
+
+        import dataclasses as _dc
+
+        cfg = _dc.replace(
+            BLOCKS12, in_height=args.height, in_width=args.width
+        )
+        att = attribute_stages(
+            init_params_deterministic(cfg),
+            deterministic_input(args.batch, cfg),
+            cfg,
+            compute=args.dtype,
+            repeats=args.repeats,
+            warmup=1,
+        )
+        device = jax.devices()[0]
+        rep = attribute_roofline(
+            dict(att.stages),
+            dtype=args.dtype,
+            batch=args.batch,
+            device_kind=device.device_kind,
+            cfg=cfg,
+            source="breakdown",
+            total_ms=att.total_ms,
+            label=f"live {device.platform}",
+        )
+        rendered += 1
+        print(json.dumps(rep.to_obj()) if args.json else rep.render())
+    if not args.bench and not args.live:
+        print("roofline: name BENCH rows and/or pass --live", file=sys.stderr)
+        return 2
+    return 0 if rendered else 2
 
 
 if __name__ == "__main__":

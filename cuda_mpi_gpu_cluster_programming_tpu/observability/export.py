@@ -53,6 +53,12 @@ _PIDS = {
 _KIND_PID = {
     "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
     "serve_miss": "serve", "serve_warm": "serve", "serve_rewarm": "serve",
+    # Live resource telemetry (ISSUE 13, docs/OBSERVABILITY.md "Roofline
+    # attribution"): periodic queue-saturation gauges and device-memory
+    # snapshots render as Perfetto COUNTER tracks (ph "C") on the serve
+    # lane — see _COUNTER_KINDS. Old journals without them export
+    # unchanged.
+    "serve_gauges": "serve", "mem_snapshot": "serve",
     # Network front end records (ISSUE 11, docs/SERVING.md "Network front
     # end & SLOs") land on the serve lane: one serve_transport per HTTP
     # exchange (span-correlated when traced — it pins ONTO its
@@ -93,6 +99,15 @@ _KIND_DUR_FIELD = {
     # — both render as slices on the incident lane.
     "sup_promote": "ms",
     "mesh_probation": "ms",
+}
+# Gauge-bearing record kinds -> the numeric fields that become counter
+# series. Each record emits one "C" (counter) event per listed field, so
+# Perfetto draws queue depth / oldest wait / memory-in-use as stepped
+# counter tracks beside the slices (the Chrome trace-event counter
+# phase). Records missing a field simply skip that series.
+_COUNTER_KINDS = {
+    "serve_gauges": ("depth", "pending_images", "oldest_wait_ms"),
+    "mem_snapshot": ("bytes_in_use", "peak_bytes_in_use"),
 }
 
 
@@ -200,6 +215,24 @@ def to_trace_events(records: List[dict]) -> dict:
             )
             continue
         pid = _kind_pid(kind)
+        if kind in _COUNTER_KINDS:
+            # Counter tracks: one "C" event per gauge field. The synthetic
+            # append-order clock keeps the series monotonic alongside the
+            # other uncorrelated records.
+            t0 = max(synth_clock.get(kind, 0.0), float(idx) * 1e3)
+            for field in _COUNTER_KINDS[kind]:
+                v = rec.get(field)
+                if isinstance(v, (int, float)):
+                    events.append(
+                        {
+                            "ph": "C", "name": f"{kind}.{field}",
+                            "cat": "journal", "ts": round(t0, 1),
+                            "pid": pid, "tid": 0,
+                            "args": {field: v},
+                        }
+                    )
+            synth_clock[kind] = t0 + 1.0
+            continue
         dur_field = _KIND_DUR_FIELD.get(kind)
         dur_ms = rec.get(dur_field) if dur_field else None
         t0 = max(synth_clock.get(kind, 0.0), float(idx) * 1e3)  # µs, ordered

@@ -29,10 +29,20 @@ function is ``@off_timed_path``.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from typing import Dict, List, Optional
 
 from ..resilience.journal import atomic_write_text
+
+# Prometheus metric-name grammar: [a-zA-Z_:][a-zA-Z0-9_:]* — the dotted
+# registry names ("serve.ok") sanitize to underscores ("serve_ok").
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    pname = _PROM_BAD.sub("_", name)
+    return pname if not pname[:1].isdigit() else f"_{pname}"
 
 
 def _nearest_rank(xs: List[float], q: float) -> Optional[float]:
@@ -171,6 +181,33 @@ class MetricsRegistry:
         fsync, rename — the journal module's artifact contract)."""
         lines = [json.dumps(obj) for _name, obj in sorted(self.snapshot().items())]
         atomic_write_text(path, "\n".join(lines) + ("\n" if lines else ""))
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every registered
+        metric — what the serving front end's ``GET /metrics`` serves so
+        the stack is scrapeable (docs/SERVING.md). Counters/gauges map
+        directly; histograms expose as summaries (p50/p99 quantile
+        samples plus ``_sum``/``_count`` — the same nearest-rank
+        percentiles every other surface reports). Metric names sanitize
+        ``.`` to ``_`` per the exposition grammar."""
+        lines: List[str] = []
+        for name, obj in self.snapshot().items():
+            pname = _prom_name(name)
+            if obj["type"] == "counter":
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {obj['value']}")
+            elif obj["type"] == "gauge":
+                lines.append(f"# TYPE {pname} gauge")
+                v = obj["value"]
+                lines.append(f"{pname} {v if v is not None else 'NaN'}")
+            else:  # histogram -> summary
+                lines.append(f"# TYPE {pname} summary")
+                for q, key in (("0.5", "p50"), ("0.99", "p99")):
+                    if obj.get(key) is not None:
+                        lines.append(f'{pname}{{quantile="{q}"}} {obj[key]}')
+                lines.append(f"{pname}_sum {obj['sum']}")
+                lines.append(f"{pname}_count {obj['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
         with self._lock:

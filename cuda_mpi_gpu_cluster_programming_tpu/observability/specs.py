@@ -1,0 +1,186 @@
+"""Device spec table: peak TFLOP/s per dtype + HBM bandwidth per TPU
+generation, plus the live device-memory snapshot helper.
+
+Until ISSUE 13 the chip-capability knowledge lived as ``bench.py``'s
+private ``_PEAK_TABLE`` — a bf16-peak-only list no other subsystem could
+consult, which is why the repo could compute whole-pass MFU but never a
+per-stage bandwidth verdict. This module is the ONE source of truth:
+``bench.peak_tflops`` delegates here, and the roofline attribution layer
+(``observability.roofline``) reads the same table for its
+compute-vs-HBM-bound classification, so a bench row's ``assumed_peak``
+and a roofline verdict can never disagree about what the chip can do.
+
+Numbers come from the public TPU spec sheets, matched against jax's
+``device_kind`` string exactly the way ``bench.py`` always has ("v5"
+matches the "TPU v5 lite" spelling v5e reports). Per-dtype peaks:
+
+- ``bf16`` — the MXU peak from the table (``BENCH_PEAK_TFLOPS``
+  overrides, same contract as the bench headline).
+- ``fp32`` — ``bf16 / 6``: ``lax.Precision.HIGHEST`` synthesizes true
+  fp32 MACs out of 6 bf16 MXU passes (the ``fp32_ceiling_fraction``
+  convention bench rows already carry).
+- ``int8w`` — equals the bf16 peak HERE, deliberately: this repo's
+  int8w forward is dequant-free bf16-accumulate (docs/PRECISION.md) —
+  the MXU executes bf16 operand passes, so the int8 TOPS column of the
+  spec sheet is not the ceiling this codebase can reach. ``int8_tops``
+  is still recorded on the spec for reference.
+
+Stdlib-only at module scope (bench imports this before jax exists);
+:func:`device_memory_stats` imports jax lazily and degrades to a
+process-RSS reading so the ``mem_snapshot`` telemetry record always has
+something truthful to say (``source`` names which reading it is).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+# lax.Precision.HIGHEST fp32 synthesis: 6 bf16 MXU passes per fp32 MAC.
+FP32_SYNTH_FACTOR = 6.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """One TPU generation's roofline-relevant capabilities."""
+
+    marker: str  # substring matched against device_kind.lower()
+    name: str
+    bf16_tflops: float  # MXU peak, dense bf16
+    hbm_gbps: float  # HBM bandwidth, GB/s per chip
+    int8_tops: Optional[float] = None  # spec-sheet int8 (reference only)
+
+    def peak_tflops(self, dtype: str = "bf16") -> float:
+        """The MXU ceiling a ``dtype`` policy of THIS repo can chase."""
+        if dtype == "fp32":
+            return self.bf16_tflops / FP32_SYNTH_FACTOR
+        # bf16 and int8w both execute bf16 MXU passes here (module doc).
+        return self.bf16_tflops
+
+    def to_obj(self) -> dict:
+        return {
+            "name": self.name,
+            "bf16_tflops": self.bf16_tflops,
+            "hbm_gbps": self.hbm_gbps,
+            "int8_tops": self.int8_tops,
+        }
+
+
+# Ordered: longer/newer markers first so "v5p" wins over "v5" (the same
+# first-match discipline bench's private table used).
+SPEC_TABLE: Tuple[DeviceSpec, ...] = (
+    DeviceSpec("v6", "TPU v6e (Trillium)", 918.0, 1640.0, 1836.0),
+    DeviceSpec("v5p", "TPU v5p", 459.0, 2765.0, 918.0),
+    DeviceSpec("v5", "TPU v5e", 197.0, 819.0, 394.0),  # kind: "TPU v5 lite"
+    DeviceSpec("v4", "TPU v4", 275.0, 1228.0, 275.0),
+    DeviceSpec("v3", "TPU v3", 123.0, 900.0, None),
+    DeviceSpec("v2", "TPU v2", 45.0, 700.0, None),
+)
+
+# Unknown kind (CPU containers, exotic relays): assume the chip we
+# actually develop on — callers surface the ``assumed`` bit visibly.
+DEFAULT_SPEC = SPEC_TABLE[2]
+
+
+def spec_for(device_kind: str) -> Tuple[DeviceSpec, bool]:
+    """``(spec, assumed)`` for a jax ``device_kind`` string. ``assumed``
+    is True when the kind matched nothing and the v5e default stands in
+    (a CPU mesh judged against an assumed chip must SAY so)."""
+    kind = (device_kind or "").lower()
+    for spec in SPEC_TABLE:
+        if spec.marker in kind:
+            return spec, False
+    return DEFAULT_SPEC, True
+
+
+def peak_tflops(device_kind: str, dtype: str = "bf16") -> float:
+    """Peak TFLOP/s for ``device_kind`` under this repo's ``dtype``
+    policies. ``BENCH_PEAK_TFLOPS`` overrides the bf16 MXU peak (the
+    historical bench contract); the fp32 ceiling scales with it."""
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        bf16 = float(env)
+    else:
+        spec, _assumed = spec_for(device_kind)
+        bf16 = spec.bf16_tflops
+    return bf16 / FP32_SYNTH_FACTOR if dtype == "fp32" else bf16
+
+
+def hbm_gbps(device_kind: str) -> float:
+    """HBM bandwidth (GB/s) for ``device_kind``; ``BENCH_PEAK_HBM_GBPS``
+    overrides (the bandwidth twin of ``BENCH_PEAK_TFLOPS``)."""
+    env = os.environ.get("BENCH_PEAK_HBM_GBPS")
+    if env:
+        return float(env)
+    spec, _assumed = spec_for(device_kind)
+    return spec.hbm_gbps
+
+
+def bf16_peak_table() -> List[Tuple[str, float]]:
+    """The historical ``bench._PEAK_TABLE`` shape — ``(marker, bf16
+    TFLOP/s)`` pairs — derived from the one spec table."""
+    return [(s.marker, s.bf16_tflops) for s in SPEC_TABLE]
+
+
+# ------------------------------------------------------- live telemetry ---
+
+
+def device_memory_stats() -> dict:
+    """One resource snapshot for the ``mem_snapshot`` journal record.
+
+    Prefers jax's per-device ``memory_stats()`` (``source="device"``:
+    bytes_in_use / peak_bytes_in_use / bytes_limit summed over local
+    devices, with the per-device list alongside); on backends that
+    expose none (the CPU container) it degrades to the process max-RSS
+    (``source="rss"``) so the telemetry lane never goes silent — the
+    record always says which reading it carries.
+    """
+    try:
+        import jax
+
+        devices = []
+        for d in jax.local_devices():
+            getter = getattr(d, "memory_stats", None)
+            stats = getter() if callable(getter) else None
+            if isinstance(stats, dict) and stats.get("bytes_in_use") is not None:
+                devices.append(
+                    {
+                        "device": getattr(d, "id", len(devices)),
+                        "bytes_in_use": int(stats["bytes_in_use"]),
+                        "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                        "bytes_limit": stats.get("bytes_limit"),
+                    }
+                )
+        if devices:
+            def _total(field: str) -> Optional[int]:
+                vals = [d.get(field) for d in devices]
+                nums = [v for v in vals if isinstance(v, (int, float))]
+                return int(sum(nums)) if nums else None
+
+            return {
+                "source": "device",
+                "bytes_in_use": _total("bytes_in_use"),
+                "peak_bytes_in_use": _total("peak_bytes_in_use"),
+                "bytes_limit": _total("bytes_limit"),
+                "devices": devices,
+            }
+    except Exception:  # backend quirks must never break the dispatch loop
+        pass
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return {
+            "source": "rss",
+            "bytes_in_use": int(rss_kb) * 1024,  # linux reports KB
+            "peak_bytes_in_use": None,
+            "bytes_limit": None,
+        }
+    except Exception:
+        return {
+            "source": "none",
+            "bytes_in_use": None,
+            "peak_bytes_in_use": None,
+            "bytes_limit": None,
+        }

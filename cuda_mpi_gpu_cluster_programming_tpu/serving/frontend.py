@@ -37,7 +37,11 @@ Wire format (``POST /v1/infer``, JSON):
 
 ``GET /healthz`` answers liveness + queue saturation gauges
 (``oldest_wait_ms`` — observable before the first shed); ``GET /stats``
-the full serve/queue counters.
+the full serve/queue counters; ``GET /metrics`` the process-wide
+metrics registry in Prometheus text exposition (0.0.4 — counters,
+gauges, histogram summaries with the repo's nearest-rank p50/p99), each
+scrape journaled as a ``serve_transport`` record like the POST
+exchanges.
 
 Handler threads block on sockets and handle waits BY DESIGN — they are
 transport, never the dispatch loop; staticcheck's
@@ -98,6 +102,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     # ------------------------------------------------------------- routes
 
     def do_GET(self) -> None:
@@ -123,6 +135,20 @@ class _Handler(BaseHTTPRequestHandler):
                     "entry": srv.sup.entry.key if srv.sup else srv.cfg.config,
                 },
             )
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the process-wide registry
+            # (docs/SERVING.md): counters/gauges map directly, histograms
+            # expose as summaries with the same nearest-rank p50/p99 every
+            # other surface reports. Journaled like the POST exchanges —
+            # one serve_transport record per scrape — so the access trail
+            # the journal IS covers the scraper too.
+            t0 = time.monotonic()
+            self._send_text(
+                200,
+                metrics_registry().prometheus(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            fe._finish("", "", t0, "METRICS", 200)
         else:
             self._send_json(404, {"error": f"no route {self.path!r}"})
 

@@ -82,6 +82,13 @@ class ServeConfig:
     # shed-by-class (docs/SERVING.md "Network front end & SLOs"). None =
     # the PR 6 behavior (hard deadlines only).
     slo: Any = None
+    # Live resource telemetry cadence (docs/OBSERVABILITY.md "Roofline
+    # attribution"): every ``mem_snapshot_s`` seconds the dispatch loop
+    # journals one ``serve_gauges`` (queue depth / pending images /
+    # oldest wait) and one ``mem_snapshot`` (device memory_stats, RSS
+    # fallback) record — strictly off the timed path, exported as
+    # Perfetto counter tracks. 0 disables.
+    mem_snapshot_s: float = 1.0
 
 
 @dataclasses.dataclass
@@ -139,6 +146,8 @@ class InferenceServer:
         # construction instant is as good an epoch as any.
         self._epoch = time.monotonic()
         self._seq_submit = 0
+        self._seq_snapshot = 0
+        self._last_snapshot = 0.0  # monotonic: first _step snapshots
         self._submit_lock = threading.Lock()  # submit() is thread-safe
         self.buckets = self._resolve_buckets()
         self._batcher = Batcher(self.queue, self.buckets)
@@ -346,6 +355,7 @@ class InferenceServer:
         # and no post-promotion dispatch can miss the compile cache.
         self._maybe_promote()
         self._observe_queue()
+        self._observe_resources()
         batch, shed = self._batcher.next_batch(self.cfg.poll_s)
         if shed:
             self._record_shed(shed)
@@ -364,6 +374,46 @@ class InferenceServer:
         reg.gauge("serve.queue_depth").set(qs.depth)
         reg.gauge("serve.queue_pending_images").set(qs.pending_images)
         reg.gauge("serve.queue_oldest_wait_ms").set(qs.oldest_wait_ms)
+
+    @off_timed_path
+    def _observe_resources(self) -> None:
+        """Live resource telemetry, throttled to ``cfg.mem_snapshot_s``
+        (docs/OBSERVABILITY.md "Roofline attribution"): one
+        ``serve_gauges`` journal record (the queue saturation trio) and
+        one ``mem_snapshot`` record (device ``memory_stats()`` summed
+        over local devices, process-RSS fallback with ``source`` named)
+        per interval, plus the ``mem.*`` registry gauges. Strictly off
+        the dispatch timed region; journal-less servers keep the gauges
+        and skip the records."""
+        if self.cfg.mem_snapshot_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_snapshot < self.cfg.mem_snapshot_s:
+            return
+        self._last_snapshot = now
+        from ..observability.specs import device_memory_stats
+
+        snap = device_memory_stats()
+        reg = metrics_registry()
+        for field in ("bytes_in_use", "peak_bytes_in_use"):
+            if isinstance(snap.get(field), (int, float)):
+                reg.gauge(f"mem.{field}").set(snap[field])
+        if self.journal is None:
+            return
+        qs = self.queue.stats()
+        self._seq_snapshot += 1
+        t_ms = round((now - self._epoch) * 1e3, 3)
+        self._journal(
+            "serve_gauges",
+            key=f"gauges:{self._seq_snapshot}",
+            t_ms=t_ms,
+            depth=qs.depth,
+            pending_images=qs.pending_images,
+            oldest_wait_ms=qs.oldest_wait_ms,
+        )
+        self._journal(
+            "mem_snapshot", key=f"mem:{self._seq_snapshot}", t_ms=t_ms, **snap
+        )
 
     @off_timed_path
     def _maybe_promote(self) -> None:

@@ -299,9 +299,13 @@ class UnreducedContractionRule(Rule):
 # observability subsystem lives here too — an instrumentation layer that
 # syncs inside the loops it instruments would corrupt every number it
 # reports. Directory scope, so it covers trace/metrics/stages/export AND
-# the ISSUE 12 replay/gate modules: the replay pacing loop re-drives a
+# the ISSUE 12 replay/gate modules (the replay pacing loop re-drives a
 # recorded arrival schedule on the wall clock, where a stray sync or
-# span write would shear the very schedule being reproduced.
+# span write would shear the very schedule being reproduced) AND the
+# ISSUE 13 roofline/specs modules the moment they exist — the roofline
+# join runs between timed regions by construction, and the specs
+# module's live memory snapshots feed an @off_timed_path telemetry
+# helper on the dispatch loop.
 _HOT_LOOP_FILES = {
     "bench.py", "harness.py", "training.py", "run.py", "supervisor.py",
     "server.py", "loadgen.py", "batcher.py", "queue.py",

@@ -155,8 +155,10 @@ else
 fi
 # Perfetto trace artifact for the serve drill (docs/OBSERVABILITY.md): the
 # serve journal carries dispatch/queue-wait spans beside its serve_batch
-# records, so the export is one command and the timeline lands next to the
-# other round evidence (open at https://ui.perfetto.dev).
+# records — and, since ISSUE 13, the serve_gauges/mem_snapshot telemetry
+# records that render as COUNTER TRACKS (queue depth + device memory over
+# the same timeline) — so the export is one command and the timeline lands
+# next to the other round evidence (open at https://ui.perfetto.dev).
 timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
     export --journal "logs/serve_smoke_${FTS}.jsonl" \
     --out "logs/trace_serve_${FTS}.json" 2>&1 | tee -a "$LOG" \
@@ -389,6 +391,23 @@ BENCH_PLAN=perf/tune_plan.json BENCH_CONFIGS=v1_jit,v3_pallas BENCH_BF16=0 \
     | grep '^{' > perf/bench_tuned_${FTS}.jsonl \
     || say "tuned bench failed — see $LOG"
 [ -s perf/bench_tuned_${FTS}.jsonl ] && tee -a "$LOG" < perf/bench_tuned_${FTS}.jsonl
+
+say "roofline attribution over the tuned headline rows (docs/OBSERVABILITY.md 'Roofline attribution')"
+# The first on-chip rows with a MEASURED per-stage breakdown get the
+# roofline verdict immediately: per-stage MFU + compute/memory-bound
+# classification ranked by headroom, plus the predicted fused-block
+# ceiling each ROADMAP-1 megakernel candidate must answer to. Rendered
+# over the rows just captured (source=breakdown when fresh, model when
+# carried) and over the committed trail for the round-over-round story
+# (echoes marked attributably, never ranked as fresh).
+if [ -s perf/bench_tuned_${FTS}.jsonl ]; then
+    timeout 300 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+        roofline perf/bench_tuned_${FTS}.jsonl 2>&1 | tee -a "$LOG" \
+        || say "roofline over the tuned rows failed — see $LOG"
+fi
+timeout 300 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    roofline BENCH_r*.json 2>&1 | tail -40 | tee -a "$LOG" \
+    || say "roofline over the committed trail failed — see $LOG"
 
 say "g8 phase-packed conv: first-ever Mosaic lowering + correctness on chip, then the adoption A/B (round-5 named lever, coded blind against a wedged chip)"
 if timeout 600 python - >>"$LOG" 2>&1 <<'EOF'

@@ -141,6 +141,18 @@ def test_bench_end_to_end_cpu_schema():
     assert all(ms >= 0 for ms in bd["stages"].values())
     assert bd["stage_sum_ms"] == pytest.approx(out["per_pass_ms"], rel=0.15)
     assert bd["method"] == "prefix-diff" and bd["batch"] == 4
+    # ISSUE 13: the roofline join rides beside the breakdown — per-stage
+    # bound verdicts ranked by headroom, the fused-block ceiling, and the
+    # assumed-spec marker on CPU (no real roof to judge against).
+    rf = out["roofline"]
+    assert rf["source"] == "breakdown" and rf["spec_assumed"] is True
+    assert {s["name"] for s in rf["stages"]} == set(bd["stages"])
+    assert all(s["bound"] in ("compute", "memory") for s in rf["stages"])
+    assert [s["headroom_ms"] for s in rf["stages"]] == sorted(
+        [s["headroom_ms"] for s in rf["stages"]], reverse=True
+    )
+    assert set(rf["blocks"]) == {"block1", "block2"}
+    assert 0 < rf["blocks"]["block2"]["fused_mfu_ceiling"] <= 1.0
 
 
 def test_bench_multi_config_sweep_one_row_per_config():

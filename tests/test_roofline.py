@@ -1,0 +1,475 @@
+"""Roofline attribution layer tests (ISSUE 13, docs/OBSERVABILITY.md
+"Roofline attribution") — CPU backend.
+
+Covers the tentpole surface: the analytic per-stage ledger summing
+EXACTLY to ``models.alexnet.flops_per_image`` (one generator feeds
+both), staged-vs-fused byte-model monotonicity with the delta equal to
+the intermediates' write+read round-trips, compute/memory-bound
+classification against the spec table's ridge point, the CPU-mesh
+integration joining a REAL ``attribute_stages`` breakdown into a ranked
+report, the committed-BENCH acceptance (roofline-over-BENCH_r05
+reproduces the bf16 MFU 0.5713 from the row's own fields), the
+echo-aware CLI, the one-source-of-truth spec table bench delegates to,
+the serve telemetry records (``serve_gauges``/``mem_snapshot``), the
+Perfetto counter tracks, and the Prometheus exposition.
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import (  # noqa: E402
+    BLOCKS12,
+    flops_per_image,
+    matmul_flops_per_image,
+    stage_flops,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.observability import (  # noqa: E402
+    specs,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.observability.roofline import (  # noqa: E402
+    BLOCKS,
+    attribute_roofline,
+    fused_blocks,
+    model_stage_split,
+    pass_ledger,
+    roofline_from_bench_row,
+    row_views,
+    stage_ledger,
+)
+
+SMALL = dataclasses.replace(BLOCKS12, in_height=63, in_width=63)
+STAGES = ("conv1", "pool1", "conv2", "pool2", "lrn2")
+
+
+# ---------------------------------------------------------------- ledger ---
+
+
+def test_stage_flops_ledger_sums_exactly_to_whole_pass_counters():
+    """The acceptance contract: the per-stage FLOP ledger and the
+    whole-pass counters come from ONE generator, so they agree exactly —
+    at the default geometry and a replaced one."""
+    for cfg in (BLOCKS12, SMALL):
+        rows = list(stage_flops(cfg))
+        assert [n for n, _f, _mm in rows] == list(STAGES)
+        assert sum(f for _n, f, _mm in rows) == flops_per_image(cfg)
+        assert sum(mm for _n, _f, mm in rows) == matmul_flops_per_image(cfg)
+        # and the byte ledger carries the same flops, batch-scaled
+        for batch in (1, 7):
+            entries = pass_ledger(cfg, dtype="fp32", batch=batch)
+            assert sum(e.flops for e in entries) == flops_per_image(cfg) * batch
+            assert (
+                sum(e.matmul_flops for e in entries)
+                == matmul_flops_per_image(cfg) * batch
+            )
+
+
+def test_ledger_activation_bytes_chain_and_dtype_policy():
+    """Stage k's output activation bytes equal stage k+1's input bytes
+    (the staged chain round-trips through HBM between taps), and the
+    dtype policy halves activation traffic fp32 -> bf16."""
+    fp32 = stage_ledger(BLOCKS12, dtype="fp32")
+    bf16 = stage_ledger(BLOCKS12, dtype="bf16")
+    for a, b in zip(fp32, fp32[1:]):
+        assert a.act_out_bytes == b.act_in_bytes
+    for e32, e16 in zip(fp32, bf16):
+        assert e32.act_in_bytes == 2 * e16.act_in_bytes
+        assert e32.act_out_bytes == 2 * e16.act_out_bytes
+    # int8w: int8 weights + fp32 per-channel scales over bf16 activations
+    i8 = stage_ledger(BLOCKS12, dtype="int8w")
+    c1 = BLOCKS12.conv1
+    assert i8[0].act_in_bytes == bf16[0].act_in_bytes
+    assert i8[0].param_bytes == (
+        c1.filter_size**2 * 3 * c1.out_channels  # int8 weights, 1 byte
+        + c1.out_channels * 2  # bf16 bias
+        + c1.out_channels * 4  # fp32 scales
+    )
+    with pytest.raises(ValueError, match="fp32"):
+        stage_ledger(BLOCKS12, dtype="fp64")
+
+
+def test_fused_byte_model_monotone_and_delta_is_intermediate_roundtrips():
+    """The satellite contract: fused <= staged for every block and dtype,
+    and the delta is EXACTLY the interior boundaries' activations written
+    once and read once (2x bytes each)."""
+    for dtype in ("fp32", "bf16", "int8w"):
+        for batch in (1, 16):
+            entries = pass_ledger(BLOCKS12, dtype=dtype, batch=batch)
+            by = {e.name: e for e in entries}
+            blocks = fused_blocks(entries, 197.0, 819.0)
+            assert [b.name for b in blocks] == ["block1", "block2"]
+            for b in blocks:
+                assert b.fused_bytes <= b.staged_bytes
+                # interior boundaries: every stage's output except the last
+                interior = sum(
+                    by[n].act_out_bytes for n in b.stages[:-1]
+                )
+                assert b.intermediate_bytes == 2 * interior
+                assert b.fused_floor_ms <= b.staged_floor_ms + 1e-12
+                assert b.fused_mfu_ceiling is not None
+                assert 0 < b.fused_mfu_ceiling <= 1.0
+
+
+def test_block_structure_matches_the_megakernel_plan():
+    assert BLOCKS == (
+        ("block1", ("conv1", "pool1")),
+        ("block2", ("conv2", "pool2", "lrn2")),
+    )
+
+
+# ----------------------------------------------------------------- specs ---
+
+
+def test_spec_table_is_the_one_source_bench_delegates_to():
+    import bench
+
+    # the historical bench surface delegates: same answers, one table
+    assert bench.peak_tflops("TPU v5 lite") == 197.0
+    assert bench.peak_tflops("TPU v4") == 275.0
+    assert bench.peak_tflops("weird-device") == 197.0  # assumed default
+    assert bench._PEAK_TABLE == specs.bf16_peak_table()
+    # per-dtype peaks: fp32 is the bf16 peak / 6 (HIGHEST synthesis);
+    # int8w runs bf16 MXU passes in this repo (dequant-free forward)
+    assert specs.peak_tflops("TPU v5 lite", "fp32") == pytest.approx(197.0 / 6)
+    assert specs.peak_tflops("TPU v5 lite", "int8w") == 197.0
+    spec, assumed = specs.spec_for("TPU v5 lite")
+    assert spec.name == "TPU v5e" and not assumed
+    assert spec.hbm_gbps == 819.0
+    _spec, assumed = specs.spec_for("cpu")
+    assert assumed  # CPU judged against the assumed default, visibly
+    # v5p must win over the v5 substring
+    assert specs.spec_for("TPU v5p")[0].bf16_tflops == 459.0
+
+
+def test_peak_env_overrides_still_honored(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "100")
+    assert bench.peak_tflops("TPU v5 lite") == 100.0
+    assert specs.peak_tflops("TPU v5 lite", "fp32") == pytest.approx(100 / 6)
+    monkeypatch.setenv("BENCH_PEAK_HBM_GBPS", "500")
+    assert specs.hbm_gbps("TPU v5 lite") == 500.0
+
+
+def test_device_memory_stats_always_reports_a_source():
+    snap = specs.device_memory_stats()
+    assert snap["source"] in ("device", "rss")
+    assert isinstance(snap["bytes_in_use"], int) and snap["bytes_in_use"] > 0
+
+
+# ------------------------------------------------------------ attribution ---
+
+
+def test_bound_classification_unit_cases():
+    """A stage above the ridge intensity is compute-bound, below it
+    memory-bound, and the floors/headroom follow the binding roof."""
+    entries = pass_ledger(BLOCKS12, dtype="bf16", batch=128)
+    by = {e.name: e for e in entries}
+    ridge = 197e12 / 819e9  # ~240 FLOP/byte on the v5e spec
+    assert by["conv2"].intensity > ridge  # the MXU stage
+    assert by["pool1"].intensity < 1.0  # pure streaming
+    rep = attribute_roofline(
+        {"conv2": 1.0, "pool1": 1.0},
+        dtype="bf16",
+        batch=128,
+        device_kind="TPU v5 lite",
+    )
+    verdicts = {s.name: s for s in rep.stages}
+    assert verdicts["conv2"].bound == "compute"
+    assert verdicts["pool1"].bound == "memory"
+    # compute-bound floor = flops/peak; memory-bound floor = bytes/bw
+    assert verdicts["conv2"].floor_ms == pytest.approx(
+        by["conv2"].flops / 197e12 * 1e3
+    )
+    assert verdicts["pool1"].floor_ms == pytest.approx(
+        by["pool1"].staged_bytes / 819e9 * 1e3
+    )
+    for s in rep.stages:
+        assert s.headroom_ms == pytest.approx(s.ms - s.floor_ms)
+    # ranked: biggest reclaimable ms first
+    assert [s.headroom_ms for s in rep.stages] == sorted(
+        [s.headroom_ms for s in rep.stages], reverse=True
+    )
+
+
+def test_model_stage_split_sums_exactly_to_total():
+    entries = pass_ledger(BLOCKS12, dtype="bf16", batch=128)
+    split = model_stage_split(5.0, entries, 197.0, 819.0)
+    assert set(split) == set(STAGES)
+    assert sum(split.values()) == pytest.approx(5.0)
+    # the split respects the floors' proportions: conv2 dominates
+    assert split["conv2"] == max(split.values())
+
+
+def test_cpu_mesh_integration_joins_a_real_breakdown():
+    """The integration acceptance: a REAL attribute_stages breakdown on
+    the CPU mesh joins into a ranked roofline report — 5 stages, MFU and
+    verdicts present (judged against the assumed spec, and saying so),
+    and the report round-trips through JSON."""
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        deterministic_input,
+        init_params_deterministic,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.stages import (
+        attribute_stages,
+    )
+
+    att = attribute_stages(
+        init_params_deterministic(SMALL),
+        deterministic_input(4, SMALL),
+        SMALL,
+        repeats=2,
+        warmup=1,
+    )
+    rep = attribute_roofline(
+        dict(att.stages),
+        dtype="fp32",
+        batch=4,
+        device_kind="cpu",
+        cfg=SMALL,
+        source="breakdown",
+        total_ms=att.total_ms,
+    )
+    assert rep.spec_assumed  # CPU: the v5e default stands in, visibly
+    assert rep.source == "breakdown"
+    assert {s.name for s in rep.stages} == set(STAGES)
+    assert rep.total_ms == pytest.approx(att.total_ms)
+    for s in rep.stages:
+        assert s.bound in ("compute", "memory")
+        assert s.mfu is not None and s.mfu >= 0
+        assert s.achieved_gbps >= 0 and s.floor_ms > 0
+        if s.ms > 0:  # a clamped-to-zero stage has nothing to reclaim
+            # CPU ms vs a TPU roof: headroom is strictly positive
+            assert s.headroom_ms > 0
+    assert {b.name for b in rep.blocks} == {"block1", "block2"}
+    obj = json.loads(json.dumps(rep.to_obj()))
+    assert [s["name"] for s in obj["stages"]] == [s.name for s in rep.stages]
+    assert obj["fused_pass_mfu_ceiling"] is not None
+    assert "roofline" in rep.render() and "fused block1" in rep.render()
+
+
+# ------------------------------------------------------------ bench rows ---
+
+
+def test_roofline_over_bench_r05_reproduces_committed_mfu():
+    """THE acceptance: the committed BENCH_r05 row's bf16 MFU 0.5713 (and
+    fp32 0.1229) recomputed from the row's OWN fields — throughput x
+    matmul FLOPs / assumed peak — not read back from the mfu field."""
+    obj = json.loads((ROOT / "BENCH_r05.json").read_text())["parsed"]
+    reports = {r.dtype: r for r in roofline_from_bench_row(obj)}
+    assert set(reports) == {"fp32", "bf16"}
+    bf16 = reports["bf16"]
+    assert round(bf16.pass_mfu, 4) == 0.5713 == obj["last_good"]["bf16"]["mfu"]
+    assert round(reports["fp32"].pass_mfu, 4) == 0.1229 == obj["last_good"]["mfu"]
+    for rep in reports.values():
+        assert rep.stale  # a last_good carry says so
+        assert rep.source == "model"  # pre-PR-9 row: no measured breakdown
+        assert rep.device_kind == "TPU v5 lite" and not rep.spec_assumed
+        assert {s.name for s in rep.stages} == set(STAGES)
+        assert sum(s.ms for s in rep.stages) == pytest.approx(rep.total_ms)
+    # per_pass_ms derived for views without it: batch/img_s
+    assert bf16.total_ms == pytest.approx(
+        obj["last_good"]["bf16"]["per_pass_ms"]
+    )
+
+
+def test_row_views_fresh_vs_stale_and_bf16_inheritance():
+    fresh = {
+        "value": 100.0, "compute": "fp32", "batch": 8,
+        "device_kind": "TPU v4", "assumed_peak_tflops": 275.0,
+        "matmul_flops_per_image": 1,
+        "bf16": {"value": 300.0, "compute": "bf16"},
+    }
+    views = row_views(fresh)
+    assert [v["dtype"] for v in views] == ["fp32", "bf16"]
+    assert all(not v["stale"] for v in views)
+    assert views[1]["batch"] == 8  # inherited from the carrier row
+    assert views[1]["device_kind"] == "TPU v4"
+    # an error round with no last_good has no measurable view
+    assert row_views({"value": 0.0, "error": "wedged"}) == []
+
+
+def test_roofline_cli_over_committed_trail_marks_echoes(tmp_path):
+    """The CLI acceptance: over the committed BENCH_r*.json trail the
+    roofline CLI ranks the five stages with MFU + bound verdicts, marks
+    the r04 echo attributably (gate.py's detection, reused), and never
+    ranks it as fresh."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.observability",
+            "roofline", *sorted(str(p) for p in ROOT.glob("BENCH_r*.json")),
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "stale (echo of BENCH_r03.json)" in out
+    assert "echo of BENCH_r03.json — stale carry, not ranked" in out
+    for stage in STAGES:
+        assert stage in out
+    assert "mfu=0.5713" in out  # the committed bf16 headline, recomputed
+    assert "STALE (last_good carry)" in out  # carries are labeled
+    assert "fused block2 (conv2+pool2+lrn2)" in out
+    assert "bound" in out and "compute" in out and "memory" in out
+    # --json emits one machine-readable object per rendered view
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.observability",
+            "roofline", "--json", str(ROOT / "BENCH_r05.json"),
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert {r["dtype"] for r in rows} == {"fp32", "bf16"}
+    assert all(r["round"] == "BENCH_r05.json" for r in rows)
+    assert all(r["stale"] for r in rows)
+
+
+def test_roofline_cli_usage_rc2(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.observability",
+            "roofline",
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "BENCH rows" in proc.stderr
+    bad = tmp_path / "nothing.json"
+    bad.write_text("not json at all")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.observability",
+            "roofline", str(bad),
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+    )
+    assert proc.returncode == 2
+
+
+# --------------------------------------------------------- live telemetry ---
+
+
+def test_serve_telemetry_journals_gauges_and_mem_snapshots(tmp_path):
+    """The dispatch loop journals serve_gauges (queue saturation trio)
+    and mem_snapshot records off the timed path, at the configured
+    cadence, with the reading's source named; the mem.* registry gauges
+    mirror them."""
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.metrics import (
+        registry,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.server import (
+        InferenceServer,
+        ServeConfig,
+    )
+
+    tiny = dataclasses.replace(BLOCKS12, in_height=35, in_width=35)
+    jp = tmp_path / "serve.jsonl"
+    srv = InferenceServer(
+        ServeConfig(
+            config="v1_jit", max_batch=2, model_cfg=tiny,
+            journal_path=str(jp), mem_snapshot_s=0.001,
+        )
+    )
+    for i in range(3):
+        srv.submit(np.full((1, 35, 35, 3), 1.0 + i, np.float32))
+    srv.run_until_drained()
+    recs = Journal.load(jp)
+    gauges = [r for r in recs if r["kind"] == "serve_gauges"]
+    snaps = [r for r in recs if r["kind"] == "mem_snapshot"]
+    assert gauges and snaps
+    for g in gauges:
+        assert {"depth", "pending_images", "oldest_wait_ms", "t_ms"} <= set(g)
+    for s in snaps:
+        assert s["source"] in ("device", "rss")
+        assert isinstance(s["bytes_in_use"], int) and s["bytes_in_use"] > 0
+    assert registry().summary().get("mem.bytes_in_use", 0) > 0
+    # mem_snapshot_s=0 disables the records entirely
+    jp2 = tmp_path / "quiet.jsonl"
+    srv2 = InferenceServer(
+        ServeConfig(
+            config="v1_jit", max_batch=2, model_cfg=tiny,
+            journal_path=str(jp2), mem_snapshot_s=0,
+        )
+    )
+    srv2.submit(np.full((1, 35, 35, 3), 1.0, np.float32))
+    srv2.run_until_drained()
+    kinds = {r["kind"] for r in Journal.load(jp2)}
+    assert "mem_snapshot" not in kinds and "serve_gauges" not in kinds
+
+
+def test_export_renders_counter_tracks_old_journals_unchanged(tmp_path):
+    """Gauge-bearing records export as Perfetto counter ("C") events —
+    one series per field — while a journal without them yields no counter
+    events at all (the old-journal contract)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.export import (
+        to_trace_events,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+
+    jp = tmp_path / "j.jsonl"
+    j = Journal(jp)
+    j.append("serve_gauges", key="g:1", t_ms=1.0, depth=3,
+             pending_images=5, oldest_wait_ms=12.5)
+    j.append("mem_snapshot", key="m:1", t_ms=1.0, source="rss",
+             bytes_in_use=1024, peak_bytes_in_use=None)
+    j.append("serve_batch", key="b:1", bucket=2, batch_ms=3.0)
+    trace = to_trace_events(Journal.load(jp))
+    cs = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    names = {e["name"] for e in cs}
+    assert names == {
+        "serve_gauges.depth", "serve_gauges.pending_images",
+        "serve_gauges.oldest_wait_ms", "mem_snapshot.bytes_in_use",
+    }  # the None-valued peak field skips its series
+    depth = next(e for e in cs if e["name"] == "serve_gauges.depth")
+    assert depth["args"] == {"depth": 3}
+    # same pid lane as the serve records; pid named in metadata
+    batch = next(
+        e for e in trace["traceEvents"] if e["name"] == "serve_batch"
+    )
+    assert depth["pid"] == batch["pid"]
+    # old journal: zero counter events
+    jp2 = tmp_path / "old.jsonl"
+    Journal(jp2).append("serve_batch", key="b:1", bucket=2, batch_ms=3.0)
+    trace2 = to_trace_events(Journal.load(jp2))
+    assert not [e for e in trace2["traceEvents"] if e["ph"] == "C"]
+
+
+def test_prometheus_exposition_format():
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("serve.ok").inc(4)
+    reg.gauge("serve.queue_depth").set(2)
+    h = reg.histogram("serve.request_ms")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    text = reg.prometheus()
+    lines = text.splitlines()
+    assert "# TYPE serve_ok counter" in lines and "serve_ok 4" in lines
+    assert "# TYPE serve_queue_depth gauge" in lines
+    assert "serve_queue_depth 2.0" in lines
+    assert "# TYPE serve_request_ms summary" in lines
+    assert 'serve_request_ms{quantile="0.5"} 2.0' in lines
+    assert 'serve_request_ms{quantile="0.99"} 3.0' in lines
+    assert "serve_request_ms_sum 6.0" in lines
+    assert "serve_request_ms_count 3" in lines
+    # dotted names sanitize; an unset gauge renders NaN, not a crash
+    reg.gauge("odd.na").to_obj()
+    assert "odd_na NaN" in reg.prometheus()

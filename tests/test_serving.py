@@ -591,6 +591,13 @@ def test_bench_serve_mode_cpu_smoke(tmp_path):
     bd = row["breakdown"]
     assert set(bd["stages"]) == {"conv1", "pool1", "conv2", "pool2", "lrn2"}
     assert bd["stage_sum_ms"] > 0
+    # ISSUE 13: serve rows carry the roofline join beside the breakdown,
+    # at the geometry the service actually dispatches.
+    rf = row["roofline"]
+    assert rf["source"] == "breakdown"
+    assert {s["name"] for s in rf["stages"]} == set(bd["stages"])
+    assert all(s["bound"] in ("compute", "memory") for s in rf["stages"])
+    assert set(rf["blocks"]) == {"block1", "block2"}
     metrics = row["metrics"]
     assert metrics["serve.ok"] == row["n_ok"]
     assert metrics["serve.batch_ms"]["count"] >= 1
@@ -606,3 +613,5 @@ def test_bench_serve_mode_cpu_smoke(tmp_path):
     assert {"serve.dispatch", "serve.queue_wait", "serve.warmup"} <= span_names
     batches = [r for r in recs if r["kind"] == "serve_batch"]
     assert batches and all(r.get("trace_id") == row["trace_id"] for r in batches)
+    kinds = {r["kind"] for r in recs}
+    assert {"serve_gauges", "mem_snapshot"} <= kinds

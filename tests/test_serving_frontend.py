@@ -180,6 +180,50 @@ def test_http_healthz_and_stats_expose_queue_gauges():
         fe.stop()
 
 
+def test_http_metrics_prometheus_exposition(tmp_path):
+    """ISSUE 13 satellite: GET /metrics serves the process-wide registry
+    in Prometheus text exposition (counters, gauges, histogram summaries
+    with the repo's nearest-rank percentiles), and the scrape is
+    journaled as a serve_transport record like every POST exchange."""
+    jp = tmp_path / "serve.jsonl"
+    srv = InferenceServer(
+        ServeConfig(
+            config="v1_jit", max_batch=4, model_cfg=CFG,
+            journal_path=str(jp),
+        )
+    )
+    srv.start()
+    fe = ServingFrontend(srv).start()
+    try:
+        code, body = _post(fe, {"shape": [1, *IMG_SHAPE], "fill": 1.0})
+        assert code == 200
+        conn = http.client.HTTPConnection(fe.host, fe.port, timeout=30)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith(
+                "text/plain; version=0.0.4"
+            )
+        finally:
+            conn.close()
+        lines = text.splitlines()
+        assert "# TYPE serve_ok counter" in lines
+        assert any(l.startswith("serve_ok ") for l in lines)
+        assert "# TYPE serve_request_ms summary" in lines
+        assert any('serve_request_ms{quantile="0.5"}' in l for l in lines)
+        assert any(l.startswith("serve_request_ms_count") for l in lines)
+        # the registry's dotted names sanitize to the exposition grammar
+        assert not any("." in l.split("{")[0].split(" ")[0] for l in lines
+                       if l and not l.startswith("#"))
+    finally:
+        fe.stop()
+        srv.stop()
+    recs = _wait_records(jp, "serve_transport", 2)
+    assert any(r.get("status") == "METRICS" for r in recs)
+
+
 def test_http_backpressure_oversize_and_malformed():
     """The admission contract on the wire: QueueFull -> 429 (+Retry-After),
     wider than the largest bucket -> 413, malformed body -> 400; every
