@@ -669,7 +669,7 @@ def _serve_grow_drill(model_cfg, journal_path: str = "") -> dict:
     offered += len(hs)
     completed += sum(1 for h in hs if h.status == OK)
     tol = float(os.environ.get("BENCH_SERVE_GROW_TOL", "0.5"))
-    return {
+    row = {
         "n_requests": offered,
         "completed": completed,
         "devices_lost": lost,
@@ -686,6 +686,25 @@ def _serve_grow_drill(model_cfg, journal_path: str = "") -> dict:
         "cache_misses_post_promote": srv.stats.cache_misses - misses_before_post,
         "cache_misses_total": srv.stats.cache_misses,
     }
+    if journal_path:
+        row["health"] = _health_obj(journal_path)
+    return row
+
+
+def _health_obj(journal_path: str) -> dict:
+    """The fleet-health sub-object for a journaled serve/grow row (ISSUE
+    15, docs/OBSERVABILITY.md "Fleet health & compile attribution"):
+    the folded HealthReport plus its one-line summary. Evidence, not the
+    headline — a fold failure is a visible note, never a lost row."""
+    try:
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.health import (
+            health_from_journal,
+        )
+
+        rep = health_from_journal(journal_path)
+        return {"summary": rep.summary_line(), **rep.to_obj()}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _plan_policy_for(model_cfg) -> str:
@@ -867,6 +886,10 @@ def _serve_main() -> int:
         row["metrics"] = metrics_registry().summary()
         if os.environ.get("BENCH_METRICS"):
             metrics_registry().export(os.environ["BENCH_METRICS"])
+        # Fleet-health fold of the run's own journal (ISSUE 15): SLO
+        # attainment with error-budget burn, availability, incidents, and
+        # compile-cost attribution beside the throughput headline.
+        row["health"] = _health_obj(journal_path)
         if os.environ.get("BENCH_SERVE_DRILL", "1") != "0":
             try:
                 row["drill"] = _serve_drill(model_cfg)

@@ -213,22 +213,48 @@ def build_forward(
         mcfg = model_cfg or _B12
         kv = _resolve_variants(plan) if exec_cfg.tier == "pallas" else None
         tier = exec_cfg.tier
-        return _jit(
-            lambda p, x: forward_blocks12_int8w(
-                p, x, mcfg, variants=kv, tier=tier
+        return _observed(
+            _jit(
+                lambda p, x: forward_blocks12_int8w(
+                    p, x, mcfg, variants=kv, tier=tier
+                ),
+                donate,
             ),
-            donate,
+            exec_cfg,
+            pol.name,
+            1,
         )
     fwd = _build_forward_fp32(exec_cfg, model_cfg, n_shards, mesh, plan, donate)
     if pol.name == "fp32":
-        return fwd
+        return _observed(fwd, exec_cfg, pol.name, n_shards)
     import jax.numpy as jnp
 
     def fwd_bf16(p, x):
         pb = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
         return fwd(pb, x.astype(jnp.bfloat16)).astype(jnp.float32)
 
-    return _jit(fwd_bf16, donate)
+    return _observed(_jit(fwd_bf16, donate), exec_cfg, pol.name, n_shards)
+
+
+def _observed(
+    fn: Callable, exec_cfg: ExecConfig, dtype: str, n_shards: int
+) -> Callable:
+    """Compile-observer gate (observability.health): when an observer is
+    installed (run/bench journal wiring), first calls per input shape are
+    timed and reported as ``compile_event`` records. With no observer —
+    every existing caller — the jitted callable is returned UNCHANGED:
+    same identity, same ``.lower()``, zero overhead."""
+    from .observability.health import get_compile_observer, observed_first_calls
+
+    if get_compile_observer() is None:
+        return fn
+    return observed_first_calls(
+        fn,
+        site="build",
+        entry=exec_cfg.key,
+        dtype=dtype,
+        n_shards=n_shards if exec_cfg.strategy != "single" else 1,
+    )
 
 
 def _jit(fn: Callable, donate: bool) -> Callable:

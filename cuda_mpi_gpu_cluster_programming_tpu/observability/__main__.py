@@ -1,5 +1,5 @@
-"""CLI: Perfetto export, journal replay, the BENCH regression gate, and
-roofline attribution.
+"""CLI: Perfetto export, journal replay, the BENCH regression gate,
+roofline attribution, and the fleet-health report.
 
     python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
         export --journal logs/serve_journal.jsonl --out logs/trace.json
@@ -12,17 +12,23 @@ roofline attribution.
         roofline BENCH_r*.json            # committed rows, echo-aware
     python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
         roofline --live [--batch N] [--height H --width W]  # measure now
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \\
+        health --journal logs/serve_journal.jsonl \\
+        [--json] [--fail-on-budget-burn]
 
 Exit codes (docs/OBSERVABILITY.md "Replay & regression gating" /
-"Roofline attribution"):
+"Roofline attribution" / "Fleet health & compile attribution"):
 
 - ``0`` — clean: trace exported / replay matched (or a what-if ran) /
-  no regression / roofline rendered.
+  no regression / roofline rendered / health report rendered (budgets
+  intact, or no gate requested).
 - ``2`` — usage: missing journal, unreplayable journal (recorded before
-  the replay schema), bad arguments, no measurable roofline view.
+  the replay schema), empty journal, bad arguments, no measurable
+  roofline view.
 - ``3`` — the gate tripped: a >10% regression with
-  ``--fail-on-regression``, or a NEUTRAL replay that broke the
-  determinism contract (per-class accounting or percentile divergence).
+  ``--fail-on-regression``, a NEUTRAL replay that broke the
+  determinism contract (per-class accounting or percentile divergence),
+  or a blown SLO error budget with ``--fail-on-budget-burn``.
 """
 
 from __future__ import annotations
@@ -155,6 +161,30 @@ def make_parser() -> argparse.ArgumentParser:
         help="print machine-readable RooflineReport objects (one JSON "
         "line per view)",
     )
+    hl = sub.add_parser(
+        "health",
+        help="fleet-health report over any journal: incident MTTR "
+        "decomposition (phases sum to wall time), availability from the "
+        "device-seconds capacity timeline, per-class SLO attainment with "
+        "error-budget burn, and compile-cost attribution",
+    )
+    hl.add_argument(
+        "--journal",
+        required=True,
+        help="a journal .jsonl file, or a directory whose *.jsonl files "
+        "are folded together",
+    )
+    hl.add_argument(
+        "--json",
+        action="store_true",
+        help="print the machine-readable HealthReport object",
+    )
+    hl.add_argument(
+        "--fail-on-budget-burn",
+        action="store_true",
+        help="exit 3 when any SLO class has burned through its error "
+        "budget (burn > 1.0) — the on_heal.sh chip-time gate mode",
+    )
     return p
 
 
@@ -241,7 +271,35 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "roofline":
         return _roofline_main(args)
-    return 2
+    if args.cmd == "health":
+        from .export import load_records
+        from .health import health_from_records
+
+        src = Path(args.journal)
+        if not src.exists():
+            print(f"no journal at {src}", file=sys.stderr)
+            return 2
+        records = load_records(src)
+        if not records:
+            print(f"empty journal at {src}", file=sys.stderr)
+            return 2
+        report = health_from_records(records)
+        if args.json:
+            print(json.dumps(report.to_obj()))
+        else:
+            print(report.render())
+        if args.fail_on_budget_burn and report.budget_blown:
+            from .health import ERROR_BUDGET
+
+            blown = [c.name or "(default)" for c in report.classes if c.blown]
+            print(
+                f"health gate: FAIL — error budget blown for class(es) "
+                f"{', '.join(blown)} (burn > 1.0x of the "
+                f"{ERROR_BUDGET:.0%} violation budget)",
+                file=sys.stderr,
+            )
+            return 3
+        return 0
 
 
 def _roofline_main(args) -> int:

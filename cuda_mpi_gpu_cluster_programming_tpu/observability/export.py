@@ -49,6 +49,10 @@ _PIDS = {
     "train": 5,
     "stages": 6,
     "journal": 7,
+    # Folded incidents (ISSUE 15, observability.health): each trip /
+    # grow-back renders as a parent slice whose per-phase children tile
+    # it end to end — the MTTR decomposition drawn to scale.
+    "incident": 8,
 }
 _KIND_PID = {
     "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
@@ -80,6 +84,11 @@ _KIND_PID = {
     # promote end to end. Old journals without them export unchanged.
     "mesh_probation": "sup", "mesh_quarantine": "sup",
     "sup_promote": "sup", "sup_promote_refused": "sup",
+    # Compile-cost records (ISSUE 15, observability.health): every XLA
+    # compile renders as a slice on the supervisor lane (correlated ones
+    # pin inside the warmup/rewarm span that paid for them, on a
+    # "compile" sub-lane). Old journals without them export unchanged.
+    "compile_event": "sup",
     "gate_pass": "tune", "gate_fail": "tune",
     "step": "train", "ckpt": "train", "rollback": "train", "resume": "train",
     "wedge_detected": "journal", "recycle": "journal", "reprobe": "journal",
@@ -99,6 +108,7 @@ _KIND_DUR_FIELD = {
     # — both render as slices on the incident lane.
     "sup_promote": "ms",
     "mesh_probation": "ms",
+    "compile_event": "ms",
 }
 # Gauge-bearing record kinds -> the numeric fields that become counter
 # series. Each record emits one "C" (counter) event per listed field, so
@@ -197,6 +207,51 @@ def to_trace_events(records: List[dict]) -> dict:
         if rec.get("span_id"):
             span_loc[rec["span_id"]] = (pid, tid, t0, t0 + dur)
 
+    # Incident lane (observability.health): fold the trail into incidents
+    # and draw every span-timed one as a parent slice whose per-phase
+    # children tile it end to end — the MTTR decomposition to scale.
+    # Span-less incidents (old/untraced journals) have no wall-clock
+    # placement and are skipped; the journal otherwise exports unchanged.
+    from .health import incidents_from_records
+
+    for inc in incidents_from_records(records):
+        if inc.t0_ms is None or inc.wall_ms <= 0:
+            continue
+        pid = _PIDS["incident"]
+        p_ts = round(inc.t0_ms * 1e3, 1)
+        p_end = round((inc.t0_ms + inc.wall_ms) * 1e3, 1)
+        if p_end <= p_ts:
+            continue
+        tid = _tid_for(pid, inc.kind, p_ts, p_end)
+        events.append(
+            {
+                "ph": "X", "name": f"incident.{inc.kind}",
+                "cat": "incident", "ts": p_ts,
+                "dur": round(p_end - p_ts, 1), "pid": pid, "tid": tid,
+                "args": {
+                    "entry": inc.entry, "cause": inc.cause,
+                    "wall_ms": round(inc.wall_ms, 3),
+                },
+            }
+        )
+        cursor = p_ts
+        for pname, v in inc.phases.items():
+            if not v or v <= 0:
+                continue
+            end = min(p_end, round(cursor + v * 1e3, 1))
+            dur = round(end - cursor, 1)
+            if dur <= 0:
+                continue
+            events.append(
+                {
+                    "ph": "X", "name": f"phase.{pname}",
+                    "cat": "incident", "ts": round(cursor, 1),
+                    "dur": dur, "pid": pid, "tid": tid,
+                    "args": {"ms": round(v, 3)},
+                }
+            )
+            cursor = end
+
     # Journal records: correlated ones pin to their span; the rest get a
     # synthetic per-kind timeline that preserves append order.
     synth_clock: Dict[str, float] = {}
@@ -205,7 +260,30 @@ def to_trace_events(records: List[dict]) -> dict:
         args = {k: v for k, v in rec.items() if k != "kind"}
         sid = rec.get("span_id")
         if sid and sid in span_loc:
-            pid, tid, _t0, t1 = span_loc[sid]
+            pid, tid, s_t0, t1 = span_loc[sid]
+            ms = rec.get("ms")
+            if (
+                kind == "compile_event"
+                and isinstance(ms, (int, float))
+                and ms > 0
+            ):
+                # A correlated compile renders as a SLICE ending where its
+                # enclosing warmup/rewarm span ends (the compile is the
+                # tail of the timed first call), on a "compile" sub-lane
+                # of the span's process row — several buckets compiling
+                # under one rewarm span would mis-nest on the span's own
+                # lane.
+                dur = float(ms) * 1e3
+                ts = max(s_t0, t1 - dur)
+                ctid = _tid_for(pid, "compile", ts, t1)
+                events.append(
+                    {
+                        "ph": "X", "name": kind, "cat": "journal",
+                        "ts": round(ts, 1), "dur": round(t1 - ts, 1),
+                        "pid": pid, "tid": ctid, "args": args,
+                    }
+                )
+                continue
             events.append(
                 {
                     "ph": "i", "name": kind, "cat": "journal",

@@ -1,0 +1,1076 @@
+"""Fleet health analytics: incident MTTR decomposition, availability &
+SLO-attainment accounting, and journaled compile-cost attribution.
+
+PRs 8-13 journal every elasticity transition (``sup_trip``/``sup_degrade``
+/``sup_reshard``/``sup_replay``, ``mesh_probation``, ``sup_promote``) and
+every serve outcome (``serve_batch``/``serve_shed``/``serve_fail``), but
+no layer answers what a fleet operator actually asks: *how long did each
+incident cost and where did the time go, what availability did the fleet
+deliver, and did each SLO class stay inside its error budget?* This
+module is that analytics layer, over the journal ALONE — any recorded
+run (serve, train, bench, replay) folds into one :class:`HealthReport`.
+
+Three parts:
+
+- **Compile-event journaling** — the one instrumentation point every
+  compiling call site shares (``Supervisor`` first-calls/``warm``,
+  serving ``warmup``/``_rewarm``, observer-gated
+  ``configs.build_forward`` first-calls). Each XLA compile journals a
+  ``compile_event`` record: site, rung entry, bucket shape, dtype,
+  batch, measured wall ms, cache hit/miss, and — best effort — the
+  compiled executable's own ``cost_analysis()`` flops/bytes, so the
+  PR 13 analytic roofline ledger gets an XLA-side oracle
+  (:data:`FLOPS_RTOL` states the agreement tolerance; backends without
+  cost analysis degrade visibly to ``unavailable``, never silently).
+  Recompilation is the known dominant MTTR component (PR 12's
+  determinism contract carves it out as process cache state); before
+  this record it was observed exactly once per supervisor lifetime.
+- **Incident reconstruction** — :func:`incidents_from_records` folds the
+  raw trail into :class:`Incident` objects: trip → degrade → compile/
+  rewarm → reshard → replay → recovered, and heal → probation → promote
+  grow-back cycles. Each carries a per-phase MTTR decomposition whose
+  phases **sum to the incident wall time by construction** (the span
+  tree gives the wall; nested child spans give exclusive phase times;
+  ``detect`` absorbs the un-attributed remainder, with a proportional
+  clamp when rounding would push the sum past the wall). Journals
+  recorded before this PR (no ``compile_event`` anywhere) report the
+  compile phase as *unattributed* — None, rendered as such — never as a
+  false zero.
+- **Availability & SLO attainment** — a device-seconds capacity timeline
+  from ``mesh_shrink``/``sup_promote`` (timestamped by the nearest
+  preceding ``t_ms``-bearing record — the serve epoch), per-class
+  served/shed/failed against each ``SLOClass`` budget with error-budget
+  burn (p99 target ⇒ a :data:`ERROR_BUDGET` violation allowance), and
+  flap/quarantine accounting. ``observability health --fail-on-
+  budget-burn`` exits 3 on a blown budget, the gate family's style.
+
+Import weight: stdlib + ``serving.slo`` (itself stdlib) at module level.
+jax is touched only inside :func:`xla_cost_analysis` and only when a
+compiling call site asks for it; the report path never imports a
+backend, so ``health`` runs on any journal anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..serving.slo import SLOClass, SLOPolicy
+
+# XLA's cost model and the analytic ledger count ops under different
+# conventions (XLA does not bill max-pool compares or LRN's fused
+# elementwise chain the way models.alexnet.flops_per_image does — on the
+# CPU backend the measured ratio sits at ~0.75x, stable across batch).
+# The cross-check therefore asserts agreement within this RELATIVE
+# tolerance, not equality; outside it the check reports "diverges" and
+# the row stays visible for triage.
+FLOPS_RTOL = 0.5
+# Each class is operated against its p99 target (slo.SLOClass.slo_ms):
+# the error budget is the 1% of completed requests allowed to violate.
+# burn = violation share / ERROR_BUDGET; burn > 1.0 is a blown budget.
+ERROR_BUDGET = 0.01
+
+# The trip-incident phase order (rendered and exported in this order;
+# they tile the incident wall time exactly).
+TRIP_PHASES = ("detect", "degrade", "compile", "rewarm", "reshard", "replay")
+GROWBACK_PHASES = ("probation", "spot_check", "compile", "promote")
+
+_DTYPE_TO_LEDGER = {
+    "float32": "fp32", "fp32": "fp32",
+    "bfloat16": "bf16", "bf16": "bf16",
+}
+
+
+def off_timed_path(fn):
+    """Same contract (and decorator NAME — what staticcheck matches) as
+    ``resilience.sentinel.off_timed_path``: never called inside a timed
+    region. Declared locally so this module stays backend-import-free."""
+    fn.__off_timed_path__ = True
+    return fn
+
+
+# --------------------------------------------------------------------------
+# compile-event journaling (the shared instrumentation point)
+
+
+@off_timed_path
+def xla_cost_analysis(fn, *args) -> Tuple[Optional[float], Optional[float]]:
+    """``(flops, bytes_accessed)`` from the compiled executable's own cost
+    model (``fn.lower(*args).compile().cost_analysis()``), or
+    ``(None, None)`` on ANY failure — a backend without cost analysis, a
+    non-jitted callable, a lowering error. The caller records None as
+    ``unavailable``; degradation is visible, never a fake number. With
+    the persistent compile cache enabled (configs.build_forward) the
+    re-lowering compiles from cache, so the probe costs a deserialize,
+    not a second compile."""
+    try:
+        compiled = fn.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
+        if not isinstance(d, dict):
+            return None, None
+        flops = d.get("flops")
+        nbytes = d.get("bytes accessed")
+        return (
+            float(flops) if isinstance(flops, (int, float)) and flops > 0 else None,
+            float(nbytes) if isinstance(nbytes, (int, float)) and nbytes > 0 else None,
+        )
+    except Exception:
+        return None, None
+
+
+@off_timed_path
+def compile_event(
+    *,
+    site: str,
+    entry: str,
+    shape: Sequence[int],
+    dtype: str,
+    ms: float,
+    cache_hit: bool,
+    n_shards: int = 1,
+    fn=None,
+    args: tuple = (),
+) -> dict:
+    """Build one ``compile_event`` payload. ``ms`` is the measured wall of
+    the first call at this (entry, shape) — compile + one run; the run is
+    noise next to the compile, and the measurement is honest about being
+    end-to-end. ``n_shards`` records the executable's partition degree:
+    XLA's ``cost_analysis`` bills the PER-SHARD module for partitioned
+    programs, so the roofline cross-check needs it to pick the right
+    convention. Cost analysis is probed only on misses (a cache hit
+    compiled nothing) and only when ``fn`` is given; the
+    ``HEALTH_COST_ANALYSIS=0`` kill switch skips the probe entirely."""
+    flops = nbytes = None
+    if (
+        fn is not None
+        and not cache_hit
+        and os.environ.get("HEALTH_COST_ANALYSIS", "1") != "0"
+    ):
+        flops, nbytes = xla_cost_analysis(fn, *args)
+    shape = [int(d) for d in shape]
+    return {
+        "site": site,
+        "entry": entry,
+        "shape": shape,
+        "batch": shape[0] if shape else 0,
+        "dtype": str(dtype),
+        "n_shards": max(1, int(n_shards)),
+        "ms": round(float(ms), 3),
+        "cache_hit": bool(cache_hit),
+        "xla_flops": flops,
+        "xla_bytes": nbytes,
+    }
+
+
+@off_timed_path
+def journal_compile_event(journal, rec: dict) -> None:
+    """Append one built :func:`compile_event` payload to a journal
+    (no-op without one), merging the open span's correlation ids so an
+    exported timeline pins each compile slice inside the rewarm/warmup
+    span that paid for it."""
+    if journal is None:
+        return
+    from .trace import current_ids
+
+    journal.append(
+        "compile_event",
+        key=f"compile:{rec['site']}:{rec['entry']}:b{rec['batch']}",
+        **{**current_ids(), **rec},
+    )
+
+
+# Observer hook for configs.build_forward first-call instrumentation.
+# Uninstrumented builds (no observer installed) return the jitted
+# callable UNCHANGED — function identity, .lower(), everything — so the
+# hook costs existing callers nothing; run/bench install an observer
+# that routes the events into their journal.
+_COMPILE_OBSERVER: Optional[Callable[[dict], None]] = None
+
+
+def set_compile_observer(
+    cb: Optional[Callable[[dict], None]]
+) -> Optional[Callable[[dict], None]]:
+    """Install the process-wide compile observer (None uninstalls);
+    returns the previous one so tests can restore it."""
+    global _COMPILE_OBSERVER
+    prev, _COMPILE_OBSERVER = _COMPILE_OBSERVER, cb
+    return prev
+
+
+def get_compile_observer() -> Optional[Callable[[dict], None]]:
+    return _COMPILE_OBSERVER
+
+
+def journal_compile_observer(journal) -> Callable[[dict], None]:
+    """An observer that journals every event — the run/bench wiring."""
+
+    def _observe(rec: dict) -> None:
+        journal_compile_event(journal, rec)
+
+    return _observe
+
+
+def observed_first_calls(
+    fn, *, site: str, entry: str, dtype: str, n_shards: int = 1
+):
+    """Wrap a jitted ``(params, x) -> out`` so the FIRST call per input
+    shape — the XLA compile — is timed and reported to the installed
+    compile observer. Only applied when an observer IS installed at
+    build time (configs.build_forward checks); every uninstrumented
+    build keeps the bare jitted callable."""
+
+    seen: set = set()
+
+    def wrapped(params, x):
+        shape = tuple(int(d) for d in getattr(x, "shape", ()))
+        if shape in seen:
+            return fn(params, x)
+        import jax
+
+        t0 = time.perf_counter()
+        out = fn(params, x)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) * 1e3
+        seen.add(shape)
+        _report_first_call(
+            site=site, entry=entry, shape=shape, dtype=dtype, ms=ms,
+            n_shards=n_shards, fn=fn, args=(params, x),
+        )
+        return out
+
+    wrapped.__wrapped__ = fn  # .lower() etc. stay reachable
+    return wrapped
+
+
+@off_timed_path
+def _report_first_call(
+    *, site, entry, shape, dtype, ms, n_shards, fn, args
+) -> None:
+    obs = get_compile_observer()
+    if obs is None:
+        return
+    obs(
+        compile_event(
+            site=site, entry=entry, shape=shape, dtype=dtype, ms=ms,
+            cache_hit=False, n_shards=n_shards, fn=fn, args=args,
+        )
+    )
+
+
+# --------------------------------------------------------------------------
+# incident reconstruction
+
+
+@dataclasses.dataclass
+class Incident:
+    """One folded incident: a trip (trip → degrade → compile/rewarm →
+    reshard → replay → recovered) or a grow-back (heal → probation →
+    promote). ``phases`` maps phase name → exclusive ms; the values sum
+    to ``wall_ms`` by construction, with ``compile: None`` meaning
+    *unattributed* (a pre-compile_event journal) — the sum identity then
+    holds over the attributed phases."""
+
+    kind: str  # "trip" | "growback"
+    index: int
+    entry: str  # rung tripped on / promoted to
+    cause: str
+    wall_ms: float
+    phases: Dict[str, Optional[float]]
+    t0_ms: Optional[float] = None  # tracer-epoch start (None: span-less)
+    trace_id: str = ""
+
+    @property
+    def phase_sum_ms(self) -> float:
+        return sum(v for v in self.phases.values() if v is not None)
+
+    def to_obj(self) -> dict:
+        return {
+            "kind": self.kind,
+            "index": self.index,
+            "entry": self.entry,
+            "cause": self.cause,
+            "wall_ms": round(self.wall_ms, 3),
+            "phases": {
+                k: (round(v, 3) if v is not None else None)
+                for k, v in self.phases.items()
+            },
+            "t0_ms": self.t0_ms,
+        }
+
+    def render(self) -> str:
+        parts = " ".join(
+            f"{k}={'unattributed' if v is None else format(v, '.1f')}"
+            for k, v in self.phases.items()
+        )
+        head = (
+            f"trip {self.cause} @{self.entry}"
+            if self.kind == "trip"
+            else f"growback -> {self.entry}"
+        )
+        return f"#{self.index} {head} wall={self.wall_ms:.1f}ms  {parts}"
+
+
+def _span_tree(spans: List[dict]):
+    kids: Dict[str, List[dict]] = {}
+    for s in spans:
+        pid = s.get("parent_id") or ""
+        if pid:
+            kids.setdefault(pid, []).append(s)
+    return kids
+
+
+def _subtree(root: dict, kids) -> List[dict]:
+    out, frontier = [root], [root]
+    while frontier:
+        nxt = []
+        for s in frontier:
+            for c in kids.get(s.get("span_id") or "", ()):
+                out.append(c)
+                nxt.append(c)
+        frontier = nxt
+    return out
+
+
+def _dur(spans: List[dict], name: str) -> float:
+    return sum(
+        float(s.get("dur_ms") or 0.0) for s in spans if s.get("name") == name
+    )
+
+
+def _clamped_phases(
+    wall: float, order: Sequence[str], raw: Dict[str, Optional[float]],
+    remainder: str,
+) -> Dict[str, Optional[float]]:
+    """Exclusive phases that sum EXACTLY to ``wall``: the ``remainder``
+    phase absorbs the unattributed rest; when attributed time exceeds the
+    wall (cross-clock rounding), every phase scales proportionally so the
+    identity survives instead of going negative."""
+    attributed = sum(v for v in raw.values() if v is not None)
+    if attributed > wall and attributed > 0.0:
+        scale = wall / attributed
+        raw = {
+            k: (v * scale if v is not None else None) for k, v in raw.items()
+        }
+        rest = 0.0
+    else:
+        rest = wall - attributed
+    phases: Dict[str, Optional[float]] = {}
+    for name in order:
+        if name == remainder:
+            phases[name] = rest
+        else:
+            phases[name] = raw.get(name)
+    return phases
+
+
+def incidents_from_records(records: List[dict]) -> List[Incident]:
+    """Fold a journal's raw trail into :class:`Incident` objects.
+
+    With span records (a traced run) the ``sup.trip``/``sup.recover``
+    span trees give each incident's wall time and the nested children
+    (``sup.degrade`` ⊃ ``serve.rewarm``, ``sup.replay`` ⊃
+    ``sup.reshard``, ``sup.promote``) give exclusive phase times.
+    Compile time comes from the ``compile_event`` records landing between
+    the trip record and its recovery record (``sup_ok``/``sup_step``) in
+    append order — journals with no ``compile_event`` anywhere report the
+    phase as None (*unattributed*). Span-less journals fall back to the
+    attributed-ms fields alone (``serve_rewarm.ms``, ``sup_promote.ms``,
+    probation ``ms``): the wall is then the phase sum by definition and
+    ``detect`` is zero — coarser, but the identity still holds."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    kids = _span_tree(spans)
+    has_ce = any(r.get("kind") == "compile_event" for r in records)
+
+    trip_spans = sorted(
+        (s for s in spans if s.get("name") == "sup.trip"),
+        key=lambda s: float(s.get("t0_ms") or 0.0),
+    )
+    recover_spans = sorted(
+        (s for s in spans if s.get("name") == "sup.recover"),
+        key=lambda s: float(s.get("t0_ms") or 0.0),
+    )
+    # Committed recoveries only: a refused candidate leaves a sup.recover
+    # span with no sup.promote child (and no sup_promote record).
+    committed = [
+        s
+        for s in recover_spans
+        if any(c.get("name") == "sup.promote" for c in _subtree(s, kids))
+    ]
+
+    trip_recs = [
+        (i, r) for i, r in enumerate(records) if r.get("kind") == "sup_trip"
+    ]
+    promote_recs = [
+        (i, r) for i, r in enumerate(records) if r.get("kind") == "sup_promote"
+    ]
+    ce_recs = [
+        (i, r)
+        for i, r in enumerate(records)
+        if r.get("kind") == "compile_event"
+    ]
+    pass_recs = [
+        (i, r)
+        for i, r in enumerate(records)
+        if r.get("kind") == "mesh_probation" and r.get("event") == "pass"
+    ]
+
+    def _ce_ms(lo: int, hi: int) -> float:
+        return sum(
+            float(r.get("ms") or 0.0) for i, r in ce_recs if lo < i < hi
+        )
+
+    incidents: List[Incident] = []
+
+    # ---- trips ----
+    end_kinds = ("sup_ok", "sup_step", "sup_trip")
+    for n, (idx, rec) in enumerate(trip_recs):
+        hi = next(
+            (
+                i
+                for i, r in enumerate(records)
+                if i > idx and r.get("kind") in end_kinds
+            ),
+            len(records),
+        )
+        raw_ce = _ce_ms(idx, hi)
+        compile_p: Optional[float] = raw_ce if has_ce else None
+        sp = trip_spans[n] if n < len(trip_spans) else None
+        if sp is not None:
+            sub = _subtree(sp, kids)
+            wall = float(sp.get("dur_ms") or 0.0)
+            degrade_s = _dur(sub, "sup.degrade")
+            rewarm_s = _dur(sub, "serve.rewarm")
+            reshard_s = _dur(sub, "sup.reshard")
+            replay_s = _dur(sub, "sup.replay")
+            c = compile_p or 0.0
+            raw = {
+                "degrade": max(0.0, degrade_s - rewarm_s),
+                "compile": compile_p,
+                "rewarm": max(0.0, rewarm_s - min(c, rewarm_s)),
+                "reshard": reshard_s,
+                "replay": max(0.0, replay_s - reshard_s),
+            }
+            phases = _clamped_phases(wall, TRIP_PHASES, raw, "detect")
+            t0 = float(sp.get("t0_ms") or 0.0)
+            trace_id = str(sp.get("trace_id") or "")
+        else:
+            rewarm_s = sum(
+                float(r.get("ms") or 0.0)
+                for i, r in enumerate(records)
+                if idx < i < hi and r.get("kind") == "serve_rewarm"
+            )
+            c = compile_p or 0.0
+            raw = {
+                "degrade": 0.0,
+                "compile": compile_p,
+                "rewarm": max(0.0, rewarm_s - min(c, rewarm_s)),
+                "reshard": 0.0,
+                "replay": 0.0,
+            }
+            wall = sum(v for v in raw.values() if v is not None)
+            phases = _clamped_phases(wall, TRIP_PHASES, raw, "detect")
+            t0, trace_id = None, ""
+        incidents.append(
+            Incident(
+                kind="trip",
+                index=len(incidents) + 1,
+                entry=str(rec.get("entry") or ""),
+                cause=str(rec.get("sdc_kind") or rec.get("cause") or "trip"),
+                wall_ms=wall,
+                phases=phases,
+                t0_ms=t0,
+                trace_id=trace_id,
+            )
+        )
+
+    # ---- grow-backs ----
+    used_pass: set = set()
+    for n, (idx, rec) in enumerate(promote_recs):
+        # The probation the heal waited out: the latest un-consumed
+        # "pass" record preceding this promotion in append order.
+        prob_ms, prob_idx = 0.0, None
+        for i, r in pass_recs:
+            if i < idx and i not in used_pass:
+                prob_ms, prob_idx = float(r.get("ms") or 0.0), i
+        if prob_idx is not None:
+            used_pass.add(prob_idx)
+        raw_ce = _ce_ms(prob_idx if prob_idx is not None else -1, idx + 1)
+        compile_p = raw_ce if has_ce else None
+        c = compile_p or 0.0
+        sp = committed[n] if n < len(committed) else None
+        if sp is not None:
+            sub = _subtree(sp, kids)
+            recover_s = float(sp.get("dur_ms") or 0.0)
+            promote_s = _dur(sub, "sup.promote")
+            wall = prob_ms + recover_s
+            raw = {
+                "probation": prob_ms,
+                "compile": compile_p,
+                "promote": max(0.0, promote_s - min(c, promote_s)),
+            }
+            phases = _clamped_phases(wall, GROWBACK_PHASES, raw, "spot_check")
+            # The incident starts when the probation the heal waited out
+            # started, prob_ms before the recover span — so an exported
+            # parent slice covers probation AND promotion to scale.
+            t0 = max(0.0, float(sp.get("t0_ms") or 0.0) - prob_ms)
+            trace_id = str(sp.get("trace_id") or "")
+        else:
+            promote_s = float(rec.get("ms") or 0.0)
+            raw = {
+                "probation": prob_ms,
+                "compile": compile_p,
+                "promote": max(0.0, promote_s - min(c, promote_s)),
+            }
+            wall = sum(v for v in raw.values() if v is not None)
+            phases = _clamped_phases(wall, GROWBACK_PHASES, raw, "spot_check")
+            t0, trace_id = None, ""
+        incidents.append(
+            Incident(
+                kind="growback",
+                index=len(incidents) + 1,
+                entry=str(rec.get("to") or ""),
+                cause=f"promote {rec.get('frm', '?')} -> {rec.get('to', '?')}",
+                wall_ms=wall,
+                phases=phases,
+                t0_ms=t0,
+                trace_id=trace_id,
+            )
+        )
+    return incidents
+
+
+# --------------------------------------------------------------------------
+# availability (device-seconds capacity timeline)
+
+
+def capacity_timeline(
+    records: List[dict],
+) -> Tuple[Optional[int], float, List[Tuple[float, int]]]:
+    """``(initial_devices, duration_ms, [(t_ms, devices), ...])``.
+
+    Journal records carry no wall timestamps; the serve epoch's
+    ``t_ms``-bearing records (``serve_submit``/``serve_gauges``/
+    ``mem_snapshot``) are the clock, so each capacity change
+    (``mesh_shrink``, ``sup_promote``) is timestamped at the nearest
+    PRECEDING ``t_ms`` — the resolution the journal affords. Journals
+    without a serve epoch report a zero duration (availability
+    degrades to None, visibly)."""
+    t = 0.0
+    dev0: Optional[int] = None
+    segs: List[Tuple[float, int]] = []
+    for r in records:
+        k = r.get("kind")
+        if k in ("serve_submit", "serve_gauges", "mem_snapshot"):
+            tm = r.get("t_ms")
+            if isinstance(tm, (int, float)):
+                t = max(t, float(tm))
+        elif k == "serve_config":
+            d = r.get("devices")
+            if dev0 is None and isinstance(d, int) and d > 0:
+                dev0 = d
+                segs.append((0.0, d))
+        elif k == "mesh_shrink":
+            before = r.get("before")
+            if dev0 is None and isinstance(before, int) and before > 0:
+                dev0 = before
+                segs.append((0.0, before))
+            after = r.get("after")
+            if isinstance(after, int):
+                segs.append((t, after))
+        elif k == "sup_promote":
+            d = r.get("devices")
+            if isinstance(d, int) and d > 0:
+                if dev0 is None:
+                    dev0 = d
+                    segs.append((0.0, d))
+                segs.append((t, d))
+    return dev0, t, segs
+
+
+def availability_from_records(
+    records: List[dict],
+) -> Tuple[Optional[float], Optional[float], Optional[int], float]:
+    """``(availability, delivered_device_ms, initial_devices,
+    duration_ms)`` — delivered device-time integrated over the capacity
+    timeline against the full-fleet ideal. None when the journal carries
+    no capacity signal or no serve epoch to time it against."""
+    dev0, dur, segs = capacity_timeline(records)
+    if dev0 is None or dur <= 0.0:
+        return None, None, dev0, dur
+    delivered = 0.0
+    cur = dev0
+    last = 0.0
+    for tm, dev in segs:
+        tm = min(max(tm, 0.0), dur)
+        delivered += cur * (tm - last)
+        cur, last = dev, tm
+    delivered += cur * (dur - last)
+    return delivered / (dev0 * dur), delivered, dev0, dur
+
+
+# --------------------------------------------------------------------------
+# SLO attainment & error-budget burn
+
+
+def _percentile(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank (the loadgen/metrics estimator — one convention
+    repo-wide, so percentiles cross-check exactly)."""
+    if not xs:
+        return None
+    ys = sorted(xs)
+    rank = max(1, int(round(q / 100.0 * len(ys) + 0.5)))
+    return ys[min(rank, len(ys)) - 1]
+
+
+@dataclasses.dataclass
+class ClassHealth:
+    """One request class's served/shed/failed accounting against its
+    :class:`~..serving.slo.SLOClass` budget."""
+
+    name: str
+    slo_ms: float  # 0 = unbounded (never burns)
+    offered: int
+    ok: int
+    shed: int
+    failed: int
+    rejected: int
+    p99_ms: Optional[float]
+    violations: int
+    burn: Optional[float]  # violation share / ERROR_BUDGET; None: unbounded
+
+    @property
+    def blown(self) -> bool:
+        return self.burn is not None and self.burn > 1.0
+
+    def to_obj(self) -> dict:
+        return {
+            "class": self.name,
+            "slo_ms": self.slo_ms,
+            "offered": self.offered,
+            "ok": self.ok,
+            "shed": self.shed,
+            "failed": self.failed,
+            "rejected": self.rejected,
+            "p99_ms": self.p99_ms,
+            "violations": self.violations,
+            "error_budget": ERROR_BUDGET,
+            "burn": (round(self.burn, 3) if self.burn is not None else None),
+            "blown": self.blown,
+        }
+
+    def render(self) -> str:
+        name = self.name or "(default)"
+        slo = f"slo={self.slo_ms:.0f}ms" if self.slo_ms else "slo=unbounded"
+        p99 = f"{self.p99_ms:.1f}ms" if self.p99_ms is not None else "n/a"
+        burn = (
+            f"burn={self.burn:.2f}x{' BLOWN' if self.blown else ''}"
+            if self.burn is not None
+            else "burn=n/a"
+        )
+        return (
+            f"{name:<14s} {slo:<14s} p99={p99:<9s} ok={self.ok} "
+            f"shed={self.shed} failed={self.failed} "
+            f"rejected={self.rejected} violations={self.violations} {burn}"
+        )
+
+
+def slo_attainment(records: List[dict]) -> List[ClassHealth]:
+    """Per-class attainment from the journal alone: offered from
+    ``serve_submit``, completions + latencies from ``serve_batch``
+    (``req_cls``/``req_lat_ms``), sheds from ``serve_shed``, failures
+    from ``serve_fail``, budgets from the ``serve_config`` SLO policy.
+    Violations = sheds + failures + completions over the class p99
+    target; burn ranks classes worst-first. Admission rejections
+    (``admitted=false``) are counted separately — a refused request
+    never entered the service and burns no serving budget."""
+    pol: Optional[SLOPolicy] = None
+    for r in records:
+        if r.get("kind") == "serve_config" and isinstance(r.get("slo"), dict):
+            pol = SLOPolicy.from_obj(r["slo"])
+    offered: Dict[str, int] = {}
+    rejected: Dict[str, int] = {}
+    lat: Dict[str, List[float]] = {}
+    shed: Dict[str, int] = {}
+    failed: Dict[str, int] = {}
+    saw_submit = False
+    for r in records:
+        k = r.get("kind")
+        if k == "serve_submit":
+            saw_submit = True
+            cls = str(r.get("cls") or "")
+            if r.get("admitted", True):
+                offered[cls] = offered.get(cls, 0) + 1
+            else:
+                rejected[cls] = rejected.get(cls, 0) + 1
+        elif k == "serve_batch":
+            cls_map = r.get("req_cls") or {}
+            for rid, ms in (r.get("req_lat_ms") or {}).items():
+                cls = str(cls_map.get(rid, ""))
+                lat.setdefault(cls, []).append(float(ms))
+        elif k == "serve_shed":
+            cls = str(r.get("cls") or "")
+            shed[cls] = shed.get(cls, 0) + 1
+        elif k == "serve_fail":
+            for cls in (r.get("req_cls") or {}).values():
+                failed[str(cls)] = failed.get(str(cls), 0) + 1
+    names = (
+        set(offered) | set(rejected) | set(lat) | set(shed) | set(failed)
+    )
+    if pol is not None:
+        names |= set(pol.classes)
+    out: List[ClassHealth] = []
+    for name in sorted(names):
+        cls_obj = (
+            pol.class_for(name) if pol is not None else SLOClass(name, 0.0)
+        )
+        ls = lat.get(name, [])
+        n_ok, n_shed, n_failed = len(ls), shed.get(name, 0), failed.get(name, 0)
+        completed = n_ok + n_shed + n_failed
+        slo_ms = float(cls_obj.slo_ms or 0.0)
+        late = sum(1 for v in ls if slo_ms and v > slo_ms)
+        violations = late + n_shed + n_failed
+        burn = (
+            (violations / completed) / ERROR_BUDGET
+            if slo_ms and completed
+            else (0.0 if slo_ms else None)
+        )
+        out.append(
+            ClassHealth(
+                name=name,
+                slo_ms=slo_ms,
+                offered=(
+                    offered.get(name, 0) if saw_submit else completed
+                ),
+                ok=n_ok,
+                shed=n_shed,
+                failed=n_failed,
+                rejected=rejected.get(name, 0),
+                p99_ms=_percentile(ls, 99),
+                violations=violations,
+                burn=burn,
+            )
+        )
+    out.sort(key=lambda c: (c.burn is not None, c.burn or 0.0), reverse=True)
+    return out
+
+
+# --------------------------------------------------------------------------
+# compile-cost attribution & the roofline cross-check
+
+
+def compile_attribution(records: List[dict]) -> dict:
+    """Fold the ``compile_event`` trail: per-(site, entry, shape, dtype)
+    compile counts/ms, totals, and the XLA-vs-analytic-ledger flops
+    cross-check (tolerance :data:`FLOPS_RTOL`; rows without XLA cost
+    analysis — or geometries the ledger cannot model — degrade to
+    ``unavailable``). ``unattributed`` is True for journals recorded
+    before this schema existed: compile time is then unknown, not zero."""
+    evs = [r for r in records if r.get("kind") == "compile_event"]
+    groups: Dict[tuple, dict] = {}
+    for r in evs:
+        key = (
+            str(r.get("site") or ""),
+            str(r.get("entry") or ""),
+            tuple(r.get("shape") or ()),
+            str(r.get("dtype") or ""),
+        )
+        g = groups.setdefault(
+            key,
+            {
+                "site": key[0], "entry": key[1], "shape": list(key[2]),
+                "dtype": key[3], "n_shards": max(1, int(r.get("n_shards") or 1)),
+                "compiles": 0, "cache_hits": 0,
+                "ms": 0.0, "xla_flops": None, "xla_bytes": None,
+            },
+        )
+        if r.get("cache_hit"):
+            g["cache_hits"] += 1
+        else:
+            g["compiles"] += 1
+            g["ms"] += float(r.get("ms") or 0.0)
+        for f in ("xla_flops", "xla_bytes"):
+            if g[f] is None and isinstance(r.get(f), (int, float)):
+                g[f] = float(r[f])
+    rows = [
+        {**g, "ms": round(g["ms"], 3)} for g in groups.values()
+    ]
+    rows.sort(key=lambda g: g["ms"], reverse=True)
+    checks = [_flops_check(g) for g in rows]
+    return {
+        "unattributed": not evs,
+        "events": len(evs),
+        "total_ms": round(
+            sum(
+                float(r.get("ms") or 0.0)
+                for r in evs
+                if not r.get("cache_hit")
+            ),
+            3,
+        ),
+        "tolerance": FLOPS_RTOL,
+        "rows": rows,
+        "flops_checks": [c for c in checks if c is not None],
+    }
+
+
+def _flops_check(g: dict) -> Optional[dict]:
+    """One row's XLA-vs-ledger verdict, from the event's own shape (the
+    geometry is in the record — no config lookup needed). Verdicts:
+    ``agree`` (within FLOPS_RTOL), ``diverges``, or ``unavailable``
+    (no XLA cost analysis on this backend / unmodelable geometry)."""
+    shape = g.get("shape") or []
+    base = {
+        "entry": g["entry"], "shape": shape, "dtype": g["dtype"],
+        "xla_flops": g["xla_flops"], "ledger_flops": None,
+        "ratio": None, "tolerance": FLOPS_RTOL,
+    }
+    if g["xla_flops"] is None:
+        return {**base, "verdict": "unavailable"}
+    ledger_dtype = _DTYPE_TO_LEDGER.get(g["dtype"])
+    if len(shape) != 4 or ledger_dtype is None:
+        return {**base, "verdict": "unavailable"}
+    try:
+        import dataclasses as _dc
+
+        from ..models.alexnet import BLOCKS12
+        from .roofline import pass_ledger
+
+        cfg = _dc.replace(
+            BLOCKS12, in_height=int(shape[1]), in_width=int(shape[2])
+        )
+        ledger = sum(
+            s.flops
+            for s in pass_ledger(
+                cfg=cfg, dtype=ledger_dtype, batch=int(shape[0])
+            )
+        )
+    except Exception:
+        return {**base, "verdict": "unavailable"}
+    if not ledger:
+        return {**base, "verdict": "unavailable"}
+    # XLA's cost model bills the PER-SHARD module for partitioned
+    # programs; the ledger bills the whole pass. Compare under both
+    # conventions and keep the closer one (the scale used is reported, so
+    # nothing is hidden) — a 2-shard executable at raw ratio ~0.5 is an
+    # agreeing per-shard module, not a divergence.
+    n_sh = max(1, int(g.get("n_shards") or 1))
+    best_scale, best_err = 1, abs(g["xla_flops"] - ledger) / ledger
+    if n_sh > 1:
+        err = abs(g["xla_flops"] * n_sh - ledger) / ledger
+        if err < best_err:
+            best_scale, best_err = n_sh, err
+    ratio = g["xla_flops"] * best_scale / ledger
+    return {
+        **base,
+        "ledger_flops": float(ledger),
+        "shard_scale": best_scale,
+        "ratio": round(ratio, 4),
+        "verdict": "agree" if best_err <= FLOPS_RTOL else "diverges",
+    }
+
+
+# --------------------------------------------------------------------------
+# the report
+
+
+@dataclasses.dataclass
+class HealthReport:
+    """The folded fleet-health view of one journal (or a directory of
+    them): incidents with MTTR decomposition, availability, ranked SLO
+    attainment, flap/quarantine accounting, and compile attribution."""
+
+    incidents: List[Incident]
+    classes: List[ClassHealth]
+    availability: Optional[float]
+    delivered_device_ms: Optional[float]
+    devices: Optional[int]
+    duration_ms: float
+    flaps: int
+    quarantines: int
+    probation_enters: int
+    probation_passes: int
+    compile: dict
+    n_records: int
+
+    @property
+    def trips(self) -> List[Incident]:
+        return [i for i in self.incidents if i.kind == "trip"]
+
+    @property
+    def mttr_ms(self) -> Optional[float]:
+        ts = self.trips
+        return sum(i.wall_ms for i in ts) / len(ts) if ts else None
+
+    @property
+    def worst_burn(self) -> Optional[float]:
+        burns = [c.burn for c in self.classes if c.burn is not None]
+        return max(burns) if burns else None
+
+    @property
+    def budget_blown(self) -> bool:
+        return any(c.blown for c in self.classes)
+
+    def to_obj(self) -> dict:
+        return {
+            "n_records": self.n_records,
+            "incidents": [i.to_obj() for i in self.incidents],
+            "mttr_ms": (
+                round(self.mttr_ms, 3) if self.mttr_ms is not None else None
+            ),
+            "availability": (
+                round(self.availability, 6)
+                if self.availability is not None
+                else None
+            ),
+            "devices": self.devices,
+            "duration_ms": round(self.duration_ms, 3),
+            "delivered_device_ms": (
+                round(self.delivered_device_ms, 3)
+                if self.delivered_device_ms is not None
+                else None
+            ),
+            "flaps": self.flaps,
+            "quarantines": self.quarantines,
+            "probation_enters": self.probation_enters,
+            "probation_passes": self.probation_passes,
+            "classes": [c.to_obj() for c in self.classes],
+            "worst_burn": (
+                round(self.worst_burn, 3)
+                if self.worst_burn is not None
+                else None
+            ),
+            "budget_blown": self.budget_blown,
+            "compile": self.compile,
+        }
+
+    def summary_line(self) -> str:
+        """One machine-parseable line for the run/train CLIs
+        (``Health: ...``)."""
+        avail = (
+            f"{self.availability * 100:.2f}%"
+            if self.availability is not None
+            else "n/a"
+        )
+        mttr = f"{self.mttr_ms:.1f}" if self.mttr_ms is not None else "n/a"
+        burn = (
+            f"{self.worst_burn:.2f}x"
+            if self.worst_burn is not None
+            else "n/a"
+        )
+        comp = (
+            "unattributed"
+            if self.compile.get("unattributed")
+            else f"{self.compile.get('total_ms', 0.0):.1f}"
+        )
+        return (
+            f"incidents={len(self.incidents)} mttr_ms={mttr} "
+            f"availability={avail} worst_burn={burn} "
+            f"compile_ms={comp} budget_blown={self.budget_blown}"
+        )
+
+    def render(self) -> str:
+        lines = [f"Fleet health: {self.summary_line()}"]
+        if self.devices is not None and self.duration_ms > 0:
+            lines.append(
+                f"  capacity: {self.devices} devices over "
+                f"{self.duration_ms / 1e3:.2f}s; flaps={self.flaps} "
+                f"quarantines={self.quarantines} "
+                f"probation={self.probation_passes}/{self.probation_enters} "
+                f"passed"
+            )
+        if self.incidents:
+            lines.append(
+                "Incidents (phase decomposition sums to wall time):"
+            )
+            for inc in self.incidents:
+                lines.append(f"  {inc.render()}")
+        else:
+            lines.append("Incidents: none")
+        if self.classes:
+            lines.append(
+                f"SLO attainment (ranked by error-budget burn; budget = "
+                f"{ERROR_BUDGET:.0%} of completed):"
+            )
+            for c in self.classes:
+                lines.append(f"  {c.render()}")
+        comp = self.compile
+        if comp.get("unattributed"):
+            lines.append(
+                "Compile attribution: unattributed (journal predates "
+                "compile_event records — compile time is unknown, not "
+                "zero)"
+            )
+        else:
+            lines.append(
+                f"Compile attribution: {comp['events']} event(s), "
+                f"{comp['total_ms']:.1f} ms compiling "
+                f"(XLA-vs-ledger tolerance ±{comp['tolerance']:.0%}):"
+            )
+            for g in comp["rows"]:
+                lines.append(
+                    f"  {g['site']:<10s} {g['entry']:<22s} "
+                    f"shape={tuple(g['shape'])} {g['dtype']} "
+                    f"compiles={g['compiles']} hits={g['cache_hits']} "
+                    f"ms={g['ms']:.1f}"
+                )
+            for c in comp["flops_checks"]:
+                if c["verdict"] == "unavailable":
+                    lines.append(
+                        f"  flops-check {c['entry']} "
+                        f"shape={tuple(c['shape'])}: unavailable "
+                        f"(no XLA cost analysis on this backend)"
+                    )
+                else:
+                    lines.append(
+                        f"  flops-check {c['entry']} "
+                        f"shape={tuple(c['shape'])}: xla={c['xla_flops']:.3e} "
+                        f"ledger={c['ledger_flops']:.3e} "
+                        f"ratio={c['ratio']:.3f} -> {c['verdict']}"
+                    )
+        return "\n".join(lines)
+
+
+def health_from_records(records: List[dict]) -> HealthReport:
+    """The one folding entry point: any journal's records (serve, train,
+    bench, replay) into a :class:`HealthReport`."""
+    availability, delivered, devices, duration = availability_from_records(
+        records
+    )
+    return HealthReport(
+        incidents=incidents_from_records(records),
+        classes=slo_attainment(records),
+        availability=availability,
+        delivered_device_ms=delivered,
+        devices=devices,
+        duration_ms=duration,
+        flaps=sum(
+            int(r.get("flaps") or 0)
+            for r in records
+            if r.get("kind") == "mesh_quarantine"
+        ),
+        quarantines=sum(
+            1 for r in records if r.get("kind") == "mesh_quarantine"
+        ),
+        probation_enters=sum(
+            1
+            for r in records
+            if r.get("kind") == "mesh_probation" and r.get("event") == "enter"
+        ),
+        probation_passes=sum(
+            1
+            for r in records
+            if r.get("kind") == "mesh_probation" and r.get("event") == "pass"
+        ),
+        compile=compile_attribution(records),
+        n_records=len(records),
+    )
+
+
+def health_from_journal(path) -> HealthReport:
+    """Load a ``.jsonl`` journal (or a directory of them) and fold it."""
+    from .export import load_records
+
+    return health_from_records(load_records(path))

@@ -240,6 +240,13 @@ class Supervisor:
         self.replays = 0  # batches/steps re-run on a new rung after a trip
         self.promotions = 0  # grow-back climbs committed (maybe_promote)
         self.compile_ms: Optional[float] = None
+        # Per-(rung, input shape) compile ledger: every first call of the
+        # CURRENT executable at a new shape is an XLA compile and journals
+        # a compile_event (observability.health); the ledger resets
+        # whenever the executable does (_advance / promote), so every
+        # post-trip and post-promotion recompile is measured — not just
+        # the first one in the supervisor's lifetime.
+        self._compiled: set = set()
         self._idx = 0
         self._fwd: Optional[Callable] = None
         self._sfn: Optional[Callable] = None
@@ -277,6 +284,46 @@ class Supervisor:
             # record written inside the trip span carries that span's ids;
             # untraced runs journal exactly the PR 5 schema.
             self.journal.append(kind, key=key, **{**current_ids(), **payload})
+
+    @off_timed_path
+    def _note_compile(
+        self, *, shape, dtype, ms, cache_hit, fn=None, args=()
+    ) -> None:
+        """Journal one ``compile_event`` for the current rung (the shared
+        instrumentation point — observability.health builds the payload,
+        including the best-effort XLA ``cost_analysis`` probe on misses).
+        Also keeps the legacy ``compile_ms`` attribute: first-ever
+        compile, what run.py's one-shot ``--supervise`` path prints."""
+        if not cache_hit and self.compile_ms is None:
+            self.compile_ms = ms
+        if self.journal is None:
+            return
+        from ..observability.health import compile_event
+
+        entry = self.entry
+        rec = compile_event(
+            site=self.site,
+            entry=entry.key,
+            shape=shape,
+            dtype=dtype,
+            ms=ms,
+            cache_hit=cache_hit,
+            # Partition degree for the flops cross-check: XLA bills the
+            # per-shard module on partitioned strategies; a replicated
+            # rung runs the FULL pass per device.
+            n_shards=(
+                entry.n_shards
+                if entry.strategy in ("halo", "staged_halo", "tp")
+                else 1
+            ),
+            fn=fn,
+            args=args,
+        )
+        self._journal(
+            "compile_event",
+            key=f"compile:{self.site}:{self.entry.key}:b{rec['batch']}",
+            **rec,
+        )
 
     def _entry_mesh(self, entry: LadderEntry):
         """The surviving-device mesh this rung runs on (None for the
@@ -409,10 +456,22 @@ class Supervisor:
         auditable in the same trail as the trips."""
         import jax
 
+        shape = tuple(int(d) for d in x.shape)
+        hit = (self.entry.key, shape) in self._compiled
         t0 = time.perf_counter()
-        out, _ = self.fwd()(params, x)
+        fwd = self.fwd()
+        out, _ = fwd(params, x)
         jax.block_until_ready(out)
         ms = (time.perf_counter() - t0) * 1e3
+        self._compiled.add((self.entry.key, shape))
+        self._note_compile(
+            shape=shape,
+            dtype=str(x.dtype),
+            ms=ms,
+            cache_hit=hit,
+            fn=None if hit else fwd,
+            args=(params, x),
+        )
         self._journal(
             "sup_warm",
             key=f"warm:{self.entry.key}:b{int(x.shape[0])}",
@@ -635,6 +694,9 @@ class Supervisor:
             self._idx += 1
             self._fwd = None
             self._sfn = None
+            # Executable dropped ⇒ compile ledger with it: the landed
+            # rung's first calls are real XLA compiles and must journal.
+            self._compiled.clear()
             try:
                 # Build eagerly: an unbuildable rung degrades again — which
                 # now includes "needs more devices than survive the shrink"
@@ -676,11 +738,26 @@ class Supervisor:
                 self._maybe_chaos_flap(entry)
                 self._maybe_chaos_mesh_shrink(entry)
                 self._maybe_chaos_device_loss(entry)
+                shape = tuple(int(d) for d in x.shape)
+                first = (entry.key, shape) not in self._compiled
                 t0 = time.perf_counter()
                 out, digests = fwd(params, x)
                 jax.block_until_ready(out)
-                if self.compile_ms is None:
-                    self.compile_ms = (time.perf_counter() - t0) * 1e3
+                if first:
+                    # First call of THIS executable at THIS shape — the
+                    # XLA compile. The old single-shot `if self.compile_ms
+                    # is None:` measured exactly one compile per supervisor
+                    # lifetime; the ledger measures every rung rebuild
+                    # after a trip or promotion too.
+                    self._compiled.add((entry.key, shape))
+                    self._note_compile(
+                        shape=shape,
+                        dtype=str(x.dtype),
+                        ms=(time.perf_counter() - t0) * 1e3,
+                        cache_hit=False,
+                        fn=fwd,
+                        args=(params, x),
+                    )
                 self._screen(out, digests)
             except SDC as e:
                 params = self._trip_and_recover(
@@ -797,8 +874,25 @@ class Supervisor:
                 self._maybe_chaos_flap(entry)
                 self._maybe_chaos_mesh_shrink(entry)
                 self._maybe_chaos_device_loss(entry)
+                shape = tuple(int(d) for d in x.shape)
+                first = (f"step:{entry.key}", shape) not in self._compiled
+                t0 = time.perf_counter()
                 out = fn(params, opt_state, x, y)
                 jax.block_until_ready(out[2])
+                if first:
+                    # Training twin of execute()'s ledger: first step of
+                    # this rung's step_fn at this batch shape is the
+                    # compile (step_fn keys are disjoint from forward
+                    # keys — a rung can hold both executables).
+                    self._compiled.add((f"step:{entry.key}", shape))
+                    self._note_compile(
+                        shape=shape,
+                        dtype=str(x.dtype),
+                        ms=(time.perf_counter() - t0) * 1e3,
+                        cache_hit=False,
+                        fn=fn,
+                        args=(params, opt_state, x, y),
+                    )
                 loss = float(out[2])
                 gnorm = float(out[3]) if len(out) > 3 else None
                 for name, v in (("loss", loss), ("grad_norm", gnorm)):
@@ -953,6 +1047,9 @@ class Supervisor:
                     self._sfn, self._fwd = built, None
                 else:
                     self._fwd, self._sfn = built, None
+                # New executable, new compile ledger: the re-warm below
+                # (on_rebuild) measures this rung's per-bucket compiles.
+                self._compiled.clear()
                 with obs_span("sup.promote", frm=cur.key, to=entry.key):
                     state = self.reshard(state)
                     if self.on_rebuild is not None:

@@ -709,6 +709,20 @@ def main(argv=None) -> int:
             # Same machine-parsed supervisor line as the one-shot
             # --supervise path (harness._RE_SUPERVISOR).
             print(f"Supervisor: {server.sup.summary()}")
+        if scfg.journal_path:
+            # One-line fleet-health fold of the run's own journal
+            # (observability.health; the full report via
+            # `observability health --journal <path>`).
+            from .observability.health import health_from_journal
+
+            try:
+                print(
+                    f"Health: "
+                    f"{health_from_journal(scfg.journal_path).summary_line()}"
+                )
+            except Exception as e:  # noqa — the fold is evidence, not
+                # the serve result; degrade visibly, never fatally.
+                print(f"Health: unavailable ({type(e).__name__}: {e})")
         return 0
 
     if args.input == "native":

@@ -221,6 +221,32 @@ class InferenceServer:
         )
 
     @off_timed_path
+    def _note_compile(self, xb: np.ndarray, ms: float, *, hit: bool) -> None:
+        """Journal one ``compile_event`` for an UNSUPERVISED warmup compile
+        (the supervised path journals through the supervisor's ledger) —
+        observability.health folds these into compile-cost attribution."""
+        if self.journal is None:
+            return
+        from ..configs import REGISTRY
+        from ..observability.health import compile_event, journal_compile_event
+
+        strategy = REGISTRY[self.cfg.config].strategy
+        journal_compile_event(
+            self.journal,
+            compile_event(
+                site="serve",
+                entry=self.cfg.config,
+                shape=xb.shape,
+                dtype=self.cfg.compute,
+                ms=ms,
+                cache_hit=hit,
+                n_shards=(self.cfg.n_shards if strategy != "single" else 1),
+                fn=None if hit else self._fwd,
+                args=(self._params, xb),
+            ),
+        )
+
+    @off_timed_path
     def warmup(self) -> None:
         """Compile every bucket shape now, before any request is waiting.
         After this, a dispatch that compiles is a counted cache miss.
@@ -232,11 +258,14 @@ class InferenceServer:
             for bucket in self.buckets:
                 xb = self._warm_input(bucket)
                 if self.sup is not None:
+                    # compile_event journaling rides the supervisor's
+                    # per-(rung, shape) ledger inside warm().
                     ms = self.sup.warm(self._params, xb)
                 else:
                     t0 = time.perf_counter()
                     jax.block_until_ready(self._fwd(self._params, xb))
                     ms = (time.perf_counter() - t0) * 1e3
+                    self._note_compile(xb, ms, hit=bucket in self._warmed)
                 self.stats.warmup_compiles += 1
                 self._warmed.add(bucket)
                 self._journal(

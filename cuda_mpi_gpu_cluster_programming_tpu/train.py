@@ -526,6 +526,19 @@ def main(argv=None) -> int:
             # on 'Elastic: .*replays='): rung, trip kinds, replay count,
             # surviving pool.
             print(f"Elastic: {sup.summary()}")
+            if jr is not None:
+                # One-line fleet-health fold of the work-dir journal
+                # (observability.health): incident MTTR, compile-cost
+                # attribution for the supervised run.
+                from .observability.health import health_from_journal
+
+                try:
+                    print(
+                        f"Health: "
+                        f"{health_from_journal(jr.path).summary_line()}"
+                    )
+                except Exception as e:  # noqa — evidence, not the result
+                    print(f"Health: unavailable ({type(e).__name__}: {e})")
     else:
         try:
             loader_cm = native.NativeDataLoader(

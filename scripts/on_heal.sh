@@ -224,6 +224,24 @@ else
     say "REPLAY SMOKE FAILED (rc=$REPLAY_RC) — journal replay diverged (rc 3) or journal unreplayable (rc 2); fix before trusting capacity what-ifs this window"
 fi
 
+say "fleet-health gate over the serve smoke journal (incident MTTR + SLO error budgets + compile attribution — docs/OBSERVABILITY.md 'Fleet health & compile attribution')"
+# Chip time is gated on a CLEAN health report over the serve smoke's own
+# journal: every folded incident's phase decomposition must sum to its
+# wall time by construction, and --fail-on-budget-burn exits 3 if any
+# SLO class burned through its error budget during the smoke — a serving
+# stack that can't hold its budgets on an idle CPU mesh has no business
+# burning chip hours this window.
+timeout 120 env JAX_PLATFORMS=cpu \
+    python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    health --journal "logs/serve_smoke_${FTS}.jsonl" \
+    --fail-on-budget-burn 2>>"$LOG" | tee -a "$LOG"
+HEALTH_RC=${PIPESTATUS[0]}
+if [ "$HEALTH_RC" = 0 ]; then
+    say "fleet-health gate OK (budgets intact, incidents decomposed, compile ms attributed; journal: logs/serve_smoke_${FTS}.jsonl)"
+else
+    say "FLEET-HEALTH GATE FAILED (rc=$HEALTH_RC) — blown SLO error budget (rc 3) or unreadable journal (rc 2); judge it before chip time (python -m cuda_mpi_gpu_cluster_programming_tpu.observability health --journal logs/serve_smoke_${FTS}.jsonl)"
+fi
+
 say "perf-regression gate over the committed BENCH trajectory (echo-aware; a >10% surviving regression blocks the window)"
 # The gate that turns bench_report from a viewer into CI: last_good
 # echoes are excluded attributably (the r02-r05 wedge trail), and any

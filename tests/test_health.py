@@ -1,0 +1,325 @@
+"""Fleet health analytics (ISSUE 15): incident MTTR decomposition that
+sums to incident wall time, availability + SLO-attainment accounting with
+error-budget burn, journaled compile-cost attribution with the
+XLA-vs-ledger flops cross-check, and the ``observability health`` CLI
+exit-code contract (0 clean / 2 unusable journal / 3 budget blown)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import (  # noqa: E402
+    BLOCKS12,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.observability import (  # noqa: E402
+    Tracer,
+    set_tracer,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.observability.health import (  # noqa: E402
+    ERROR_BUDGET,
+    TRIP_PHASES,
+    health_from_journal,
+    health_from_records,
+    slo_attainment,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos  # noqa: E402
+from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import (  # noqa: E402
+    Journal,
+)
+from cuda_mpi_gpu_cluster_programming_tpu.serving.slo import (  # noqa: E402
+    SLOClass,
+    SLOPolicy,
+)
+
+
+def _cli(journal, *flags):
+    """Run ``observability health`` in a subprocess; return the proc."""
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.env_info import (
+        cpu_subprocess_env,
+    )
+
+    return subprocess.run(
+        [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.observability",
+            "health", "--journal", str(journal), *flags,
+        ],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+        env=cpu_subprocess_env(1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: seeded device loss under a traced supervised server
+
+
+@pytest.fixture(scope="module")
+def drill_journal(tmp_path_factory):
+    """One seeded device-loss serve drill, journaled under a tracer —
+    shared by the fold + CLI tests below (the drill compiles, so run it
+    once per module)."""
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.queue import OK
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.server import (
+        InferenceServer,
+        ServeConfig,
+    )
+
+    jp = tmp_path_factory.mktemp("health") / "serve.jsonl"
+    m = dataclasses.replace(BLOCKS12, in_height=63, in_width=63)
+    scfg = ServeConfig(
+        config="v2.2_sharded", n_shards=2, max_batch=4, supervise=True,
+        journal_path=str(jp), model_cfg=m,
+    )
+    saved = os.environ.get(chaos.CHAOS_ENV)
+    os.environ[chaos.CHAOS_ENV] = "seed=3,device_loss=1"
+    chaos.reset()
+    try:
+        srv = InferenceServer(scfg)
+        set_tracer(Tracer(journal=srv.journal, seed=1))
+        handles = [
+            srv.submit(np.full((1, 63, 63, 3), 1.0 + 0.01 * i, np.float32))
+            for i in range(4)
+        ]
+        srv.run_until_drained()
+    finally:
+        set_tracer(None)
+        if saved is None:
+            os.environ.pop(chaos.CHAOS_ENV, None)
+        else:
+            os.environ[chaos.CHAOS_ENV] = saved
+        chaos.reset()
+    assert [h.status for h in handles] == [OK] * 4
+    return jp
+
+
+def test_drill_incident_phases_sum_to_wall(drill_journal):
+    """The tentpole identity: the reconstructed trip incident's phase
+    decomposition (detect/degrade/compile/rewarm/reshard/replay) sums
+    EXACTLY to the incident's wall time, and compile is attributed from
+    the journaled compile_event trail (not guessed)."""
+    rep = health_from_journal(drill_journal)
+    assert len(rep.trips) == 1
+    inc = rep.trips[0]
+    assert inc.cause == "device_loss" and inc.wall_ms > 0
+    assert set(inc.phases) == set(TRIP_PHASES)
+    assert inc.phase_sum_ms == pytest.approx(inc.wall_ms, abs=1e-6)
+    # attributed, not unattributed: the supervisor journaled the rebuild
+    # compiles, so the compile phase is a number (possibly 0.0 if every
+    # bucket was warm), never None on a PR-15 journal
+    assert inc.phases["compile"] is not None
+    assert rep.mttr_ms == pytest.approx(inc.wall_ms)
+    # the trip span's ids make it into the incident (Perfetto correlation)
+    assert inc.t0_ms is not None and inc.trace_id
+
+
+def test_drill_compile_attribution_and_flops_tolerance(drill_journal):
+    """Compile-cost attribution: >=1 journaled compile_event backs the
+    report, and every XLA-vs-analytic-ledger flops check either agrees
+    within the stated tolerance or degrades VISIBLY to unavailable —
+    never a silently wrong number."""
+    rep = health_from_journal(drill_journal)
+    comp = rep.compile
+    assert comp["unattributed"] is False
+    assert comp["events"] >= 1 and comp["total_ms"] > 0
+    assert comp["rows"] and comp["rows"][0]["compiles"] >= 1
+    for chk in comp["flops_checks"]:
+        assert chk["verdict"] in ("agree", "unavailable"), chk
+    # the render names the tolerance and the summary line is parseable
+    text = rep.render()
+    assert "Compile attribution:" in text
+    fields = dict(
+        kv.split("=", 1) for kv in rep.summary_line().split()
+    )
+    assert fields["incidents"] == str(len(rep.incidents))
+    assert float(fields["compile_ms"]) == pytest.approx(
+        comp["total_ms"], abs=0.05  # the line prints one decimal
+    )
+
+
+def test_drill_cli_reports_and_exits_zero(drill_journal):
+    """`observability health --journal <drill>` renders >=1 incident and
+    exits 0 — including under --fail-on-budget-burn (no SLO class blew
+    its budget in a clean drill)."""
+    proc = _cli(drill_journal, "--fail-on-budget-burn")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Fleet health:" in proc.stdout
+    assert "incidents=1" in proc.stdout
+    assert "Incidents (phase decomposition sums to wall time):" in proc.stdout
+    proc = _cli(drill_journal, "--json")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    obj = json.loads(proc.stdout)
+    assert obj["incidents"] and obj["budget_blown"] is False
+    inc = obj["incidents"][0]
+    assert sum(
+        v for v in inc["phases"].values() if v is not None
+    ) == pytest.approx(inc["wall_ms"], abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# grow-back: heal -> probation -> promote as one incident
+
+
+def test_growback_drill_attributes_probation(monkeypatch, tmp_path):
+    """The ISSUE 10 grow-back drill folds into ONE growback incident with
+    the probation soak attributed as its own phase — and the
+    decomposition still sums to the incident wall."""
+    import jax
+    import optax
+
+    from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+        init_params_deterministic,
+        init_params_random,
+        random_input,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.supervisor import (
+        Supervisor,
+        train_ladder,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.training import (
+        make_elastic_step_builder,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.models.alexnet import (
+        forward_blocks12,
+    )
+
+    cfg = dataclasses.replace(BLOCKS12, in_height=63, in_width=63)
+    steps = 5
+    teacher = init_params_deterministic(cfg)
+    teacher_fwd = jax.jit(lambda p, x: forward_blocks12(p, x, cfg))
+    params = init_params_random(jax.random.PRNGKey(0), cfg)
+    keys = jax.random.split(jax.random.PRNGKey(9), steps)
+    xs = [random_input(k, 2, cfg) for k in keys]
+    ys = [teacher_fwd(teacher, x) for x in xs]
+
+    monkeypatch.setenv(chaos.CHAOS_ENV, "seed=3,mesh_shrink=2,device_rejoin=2")
+    chaos.reset()
+    opt = optax.sgd(1e-3)
+    jr = Journal(tmp_path / "sup.jsonl")
+    sup = Supervisor(
+        cfg, train_ladder(sp_shards=4),
+        step_builder=make_elastic_step_builder(cfg, optimizer=opt),
+        journal=jr,
+    )
+    opt_state = opt.init(params)
+    try:
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            out = sup.supervise_step(params, opt_state, x, y, step=i)
+            params, opt_state = out[0], out[1]
+            promoted = sup.maybe_promote(params, opt_state)
+            if promoted is not None:
+                params, opt_state = promoted
+    finally:
+        monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+        chaos.reset()
+    assert sup.promotions == 1
+
+    rep = health_from_records(Journal.load(tmp_path / "sup.jsonl"))
+    growbacks = [i for i in rep.incidents if i.kind == "growback"]
+    assert len(growbacks) == 1
+    gb = growbacks[0]
+    assert gb.entry == "halo@4:reference"
+    assert gb.phases["probation"] is not None and gb.phases["probation"] > 0
+    assert gb.phase_sum_ms == pytest.approx(gb.wall_ms, abs=1e-6)
+    # the probation ledger matches the journal trail
+    assert rep.probation_enters == 1 and rep.probation_passes == 1
+    # the shrink trip folded too, alongside (not merged into) the growback
+    assert len(rep.trips) == 1 and rep.trips[0].cause == "mesh_shrink"
+
+
+# ---------------------------------------------------------------------------
+# back-compat: pre-ISSUE-15 journals (no compile_event records)
+
+
+def test_old_journal_reports_compile_unattributed(tmp_path):
+    """A journal recorded before compile_event existed reports compile
+    time as UNATTRIBUTED (None / 'unattributed'), not as zero and not as
+    a crash — unknown is not free."""
+    jp = tmp_path / "old.jsonl"
+    j = Journal(jp)
+    j.append("sup_trip", key="trip:1", sdc_kind="device_loss", step=0,
+             entry="halo@2:reference")
+    j.append("serve_rewarm", key="rewarm:1", ms=12.0, buckets=[2])
+    j.append("sup_ok", key="ok:0", step=0)
+    rep = health_from_records(Journal.load(jp))
+    assert rep.compile["unattributed"] is True
+    assert len(rep.trips) == 1
+    inc = rep.trips[0]
+    assert inc.phases["compile"] is None  # unknown, NOT 0.0
+    assert inc.phases["rewarm"] == pytest.approx(12.0)
+    assert inc.phase_sum_ms == pytest.approx(inc.wall_ms, abs=1e-6)
+    assert "compile_ms=unattributed" in rep.summary_line()
+    assert "unknown, not" in rep.render() or "unattributed" in rep.render()
+
+
+# ---------------------------------------------------------------------------
+# SLO attainment math + the CLI exit-code contract
+
+
+def _blowout_journal(jp):
+    """A hand-built journal where class "tight" blows its error budget
+    (1 of 3 completions late -> burn 33x of the 1% budget) while "loose"
+    stays clean and one rejected submit burns nothing."""
+    j = Journal(jp)
+    pol = SLOPolicy([SLOClass("tight", 10.0), SLOClass("loose", 5000.0)])
+    j.append("serve_config", key="cfg", slo=pol.to_obj(), devices=2)
+    for i in range(3):
+        j.append("serve_submit", key=f"s:{i}", cls="tight", admitted=True)
+    j.append("serve_submit", key="s:r", cls="tight", admitted=False)
+    j.append(
+        "serve_batch", key="b:0", bucket=2, batch_ms=60.0,
+        req_lat_ms={"r0": 50.0, "r1": 5.0, "r2": 6.0},
+        req_cls={"r0": "tight", "r1": "tight", "r2": "tight"},
+    )
+    return jp
+
+
+def test_slo_attainment_burn_ranking_and_rejections(tmp_path):
+    classes = slo_attainment(
+        Journal.load(_blowout_journal(tmp_path / "j.jsonl"))
+    )
+    by_name = {c.name: c for c in classes}
+    tight, loose = by_name["tight"], by_name["loose"]
+    # ranked worst-first
+    assert classes[0].name == "tight"
+    assert tight.ok == 3 and tight.violations == 1
+    assert tight.burn == pytest.approx((1 / 3) / ERROR_BUDGET)
+    assert tight.blown and not loose.blown
+    assert loose.burn == 0.0 and loose.violations == 0
+    # the admission rejection is accounted but burns no serving budget
+    assert tight.rejected == 1 and tight.offered == 3
+    assert tight.p99_ms == pytest.approx(50.0)
+
+
+def test_cli_exit_codes(tmp_path):
+    """0 = clean, 2 = missing/empty journal, 3 = budget blown under
+    --fail-on-budget-burn (and still 0 without the flag: reporting a
+    blowout is not failing on it)."""
+    proc = _cli(tmp_path / "nope.jsonl")
+    assert proc.returncode == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    proc = _cli(empty)
+    assert proc.returncode == 2
+
+    jp = _blowout_journal(tmp_path / "blown.jsonl")
+    proc = _cli(jp)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "BLOWN" in proc.stdout
+    proc = _cli(jp, "--fail-on-budget-burn")
+    assert proc.returncode == 3
+    assert "tight" in proc.stderr  # names the blown class
+    proc = _cli(jp, "--json", "--fail-on-budget-burn")
+    assert proc.returncode == 3
+    obj = json.loads(proc.stdout)
+    assert obj["budget_blown"] is True
+    assert obj["classes"][0]["class"] == "tight"
+    assert obj["classes"][0]["blown"] is True

@@ -442,11 +442,43 @@ def test_export_renders_counter_tracks_old_journals_unchanged(tmp_path):
         e for e in trace["traceEvents"] if e["name"] == "serve_batch"
     )
     assert depth["pid"] == batch["pid"]
-    # old journal: zero counter events
+    # old journal: zero counter events — and (ISSUE 15) zero compile or
+    # incident slices, since those render only from compile_event records
+    # and reconstructed incidents, neither of which old journals contain.
     jp2 = tmp_path / "old.jsonl"
     Journal(jp2).append("serve_batch", key="b:1", bucket=2, batch_ms=3.0)
     trace2 = to_trace_events(Journal.load(jp2))
     assert not [e for e in trace2["traceEvents"] if e["ph"] == "C"]
+    names2 = {e["name"] for e in trace2["traceEvents"]}
+    assert not [n for n in names2 if n.startswith(("compile_event",
+                                                   "incident.", "phase."))]
+
+
+def test_export_renders_compile_events_as_slices(tmp_path):
+    """ISSUE 15: compile_event records render as duration slices on the
+    supervisor lane's compile sub-lane, sized by their measured ms."""
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.export import (
+        to_trace_events,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
+
+    jp = tmp_path / "c.jsonl"
+    j = Journal(jp)
+    j.append("compile_event", key="compile:sup:halo8:b1", site="sup",
+             entry="halo8", shape=[1, 67, 67, 3], batch=1, dtype="fp32",
+             n_shards=2, ms=120.0, cache_hit=False, xla_flops=1.0e9,
+             xla_bytes=2.0e6, t_ms=500.0)
+    j.append("compile_event", key="compile:sup:halo8:b1", site="sup",
+             entry="halo8", shape=[1, 67, 67, 3], batch=1, dtype="fp32",
+             n_shards=2, ms=0.2, cache_hit=True, xla_flops=None,
+             xla_bytes=None, t_ms=900.0)
+    trace = to_trace_events(Journal.load(jp))
+    slices = [e for e in trace["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "compile_event"]
+    assert len(slices) == 2
+    big = max(slices, key=lambda e: e["dur"])
+    assert big["dur"] >= 120.0 * 1e3 * 0.99  # us, sized by measured ms
+    assert big["args"]["cache_hit"] is False
 
 
 def test_prometheus_exposition_format():

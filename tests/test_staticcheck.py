@@ -809,13 +809,13 @@ def test_observability_scope_and_shipped_modules_clean():
         assert not rule.applies(
             Path("cuda_mpi_gpu_cluster_programming_tpu/analysis.py")
         )
-    # ISSUE 12/13: the directory scope grows with the subsystem — the
+    # ISSUE 12/13/15: the directory scope grows with the subsystem — the
     # replay pacing loop (a timed loop re-driving a recorded arrival
-    # schedule), the gate, and the roofline/specs modules are covered the
-    # moment they exist, and ship clean.
+    # schedule), the gate, the roofline/specs modules, and the fleet
+    # health analyzer are covered the moment they exist, and ship clean.
     for mod in (
         "trace.py", "metrics.py", "stages.py", "export.py",
-        "replay.py", "gate.py", "roofline.py", "specs.py",
+        "replay.py", "gate.py", "roofline.py", "specs.py", "health.py",
     ):
         for rule in (HostSyncInHotLoopRule(), SpanWriteInTimedRegionRule()):
             assert rule.applies(Path(f"{obs}/{mod}"))
