@@ -71,10 +71,17 @@ SERVE_METRIC = "alexnet_blocks12_serve_images_per_sec"
 # "gate" = the BENCH_r*.json regression gate: one JSON row with the
 # structured verdict (>10% headline/stage regressions, last_good echoes
 # excluded attributably); exit 3 on any regression.
+# "route" = the fleet-router host-loss drill (docs/SERVING.md "Fleet
+# router"): N backend processes behind serving.router.FleetRouter, a
+# pre-loss and post-loss load window with the seeded backend SIGKILLed
+# between them (chaos host_loss), restart + probation re-admission; one
+# JSON row with pre/post img/s, redirects, unroutable, recovery_ms and
+# the router's closed per-class accounting.
 MODE = os.environ.get("BENCH_MODE", "measure")
 SATURATE_METRIC = "alexnet_blocks12_serve_saturation"
 REPLAY_METRIC = "alexnet_blocks12_serve_replay"
 GATE_METRIC = "alexnet_blocks12_bench_gate"
+ROUTE_METRIC = "alexnet_blocks12_route_host_loss"
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
 # Opt-in sweep: one JSON row per listed config (the V1->V5 story); unset =
@@ -1115,6 +1122,171 @@ def _gate_main() -> int:
     return 0 if verdict.ok else 3
 
 
+def _route_main() -> int:
+    """BENCH_MODE=route: one JSON row for the fleet-router host-loss
+    drill (docs/SERVING.md "Fleet router"). N backend serving PROCESSES
+    behind serving.router.FleetRouter, a pre-loss load window, the
+    seeded backend SIGKILLed between windows (chaos ``host_loss`` — the
+    parent holds the kill switch; children never see CHAOS_SPEC), a
+    post-loss window riding retry-with-redirect, then restart +
+    probation re-admission. The row carries pre/post img/s, redirects,
+    unroutable count, recovery_ms and the router's closed per-class
+    accounting beside the stitched health fold.
+
+    Tunables (env): BENCH_ROUTE_N (3), BENCH_ROUTE_RATE (30 req/s),
+    BENCH_ROUTE_DURATION (2 s per window), BENCH_ROUTE_HEIGHT/WIDTH
+    (63 — the CI geometry), BENCH_ROUTE_MAX_BATCH (4), BENCH_ROUTE_SEED
+    (0), BENCH_ROUTE_JOURNAL (tempdir), BENCH_ROUTE_CHAOS
+    (seed=<seed>,host_loss=1; set to "" to skip the kill and measure
+    steady routing only). Always exactly one JSON line, exit 0.
+    """
+    import tempfile
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
+
+    def fail(msg: str, platform: str = "unknown") -> int:
+        row = _error_obj(msg, platform)
+        row["metric"] = ROUTE_METRIC
+        print(json.dumps(row))
+        return 0
+
+    ok, info = probe(PROBE_TIMEOUT)
+    if not ok:
+        return fail(f"device {info}")
+    platform = info
+    try:
+        import time as _time
+        from pathlib import Path
+
+        from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+        from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
+            RetryPolicy,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.batcher import (
+            power_of_two_buckets,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.fleet import (
+            BackendFleet,
+            maybe_host_loss,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.frontend import (
+            http_fleet_load,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.router import (
+            UP,
+            FleetRouter,
+            RouterConfig,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.traffic import (
+            default_class_mix,
+        )
+
+        n = int(os.environ.get("BENCH_ROUTE_N", "3"))
+        rate = float(os.environ.get("BENCH_ROUTE_RATE", "30"))
+        duration = float(os.environ.get("BENCH_ROUTE_DURATION", "2"))
+        height = int(os.environ.get("BENCH_ROUTE_HEIGHT", "63"))
+        width = int(os.environ.get("BENCH_ROUTE_WIDTH", "63"))
+        max_batch = int(os.environ.get("BENCH_ROUTE_MAX_BATCH", "4"))
+        seed = int(os.environ.get("BENCH_ROUTE_SEED", "0"))
+        journal_dir = Path(
+            os.environ.get("BENCH_ROUTE_JOURNAL")
+            or tempfile.mkdtemp(prefix="route_bench_")
+        )
+        journal_dir.mkdir(parents=True, exist_ok=True)
+        # Arm the host-loss site in THIS process only: BackendFleet pops
+        # CHAOS_SPEC from child envs, so the drill fires exactly once,
+        # from the parent, between the two load windows.
+        spec = os.environ.get("BENCH_ROUTE_CHAOS", f"seed={seed},host_loss=1")
+        prev_spec = os.environ.get(chaos.CHAOS_ENV)
+        if spec:
+            os.environ[chaos.CHAOS_ENV] = spec
+        chaos.reset()
+        fleet = BackendFleet(
+            n, journal_dir, height=height, width=width, max_batch=max_batch
+        )
+        router = None
+        try:
+            fleet.start()
+            router = FleetRouter(
+                fleet.urls(),
+                RouterConfig(
+                    probe_interval_s=0.1,
+                    probe_timeout_s=2.0,
+                    fail_k=2,
+                    readmit_m=2,
+                    retry=RetryPolicy(
+                        max_retries=3,
+                        base_delay_s=0.02,
+                        max_delay_s=0.25,
+                        jitter=0.1,
+                    ),
+                    default_deadline_s=30.0,
+                    journal_path=str(journal_dir / "router.jsonl"),
+                ),
+            ).start()
+            mix = list(default_class_mix(power_of_two_buckets(max_batch)))
+            img_shape = (height, width, 3)
+            pre = http_fleet_load(
+                router.url, img_shape, shape="steady", rate_rps=rate,
+                duration_s=duration, classes=mix, seed=seed,
+            )
+            killed = maybe_host_loss(fleet) if spec else None
+            t_kill = _time.monotonic()
+            post = http_fleet_load(
+                router.url, img_shape, shape="steady", rate_rps=rate,
+                duration_s=duration, classes=mix, seed=seed + 1,
+            )
+            recovery_ms = None
+            if killed is not None:
+                router.replace_backend(killed, fleet.restart(killed))
+                wait_until = _time.monotonic() + 60.0
+                while (
+                    _time.monotonic() < wait_until
+                    and router.backend_states()[f"b{killed}"] != UP
+                ):
+                    _time.sleep(0.05)
+                if router.backend_states()[f"b{killed}"] == UP:
+                    recovery_ms = round((_time.monotonic() - t_kill) * 1e3, 1)
+            rrep = router.report()
+        finally:
+            if router is not None:
+                router.stop()
+            fleet.stop()
+            if spec:
+                if prev_spec is None:
+                    os.environ.pop(chaos.CHAOS_ENV, None)
+                else:
+                    os.environ[chaos.CHAOS_ENV] = prev_spec
+                chaos.reset()
+        row = {
+            "metric": ROUTE_METRIC,
+            # Headline = post-loss sustained throughput: what the fleet
+            # still delivers while one host is dead.
+            "value": round(post.sustained_img_s, 1),
+            "unit": "img/s",
+            "n_backends": n,
+            "pre_loss_img_s": round(pre.sustained_img_s, 1),
+            "post_loss_img_s": round(post.sustained_img_s, 1),
+            "killed": f"b{killed}" if killed is not None else None,
+            "recovery_ms": recovery_ms,
+            "redirects": rrep.redirects,
+            "unroutable": rrep.n_unroutable,
+            "accounting_closed": rrep.closed,
+            "backends": dict(rrep.backends),
+            "router": rrep.to_obj(),
+            "rate_rps": rate,
+            "duration_s": duration,
+            "chaos": spec,
+            "journal_dir": str(journal_dir),
+            "platform": platform,
+        }
+        row["health"] = _health_obj(str(journal_dir))
+        print(json.dumps(row))
+        return 0
+    except Exception as e:
+        return fail(f"{type(e).__name__}: {e}"[:200], platform)
+
+
 def _measure_once(configs=None) -> list:
     """One full probe+measure pass; returns the JSON row list to emit, one
     row per ``configs`` entry (default: the full BENCH_CONFIGS list; the
@@ -1241,6 +1413,8 @@ def main() -> int:
         return _replay_main()
     if MODE == "gate":
         return _gate_main()
+    if MODE == "route":
+        return _route_main()
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,

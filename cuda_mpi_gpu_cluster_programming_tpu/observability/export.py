@@ -53,6 +53,11 @@ _PIDS = {
     # grow-back renders as a parent slice whose per-phase children tile
     # it end to end — the MTTR decomposition drawn to scale.
     "incident": 8,
+    # Fleet router tier (ISSUE 16, serving.router): route verdicts,
+    # journaled redirects, and backend state transitions on their own
+    # lane — stitched beside each backend's serve lane when the shared
+    # journal DIRECTORY is exported.
+    "router": 9,
 }
 _KIND_PID = {
     "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
@@ -89,6 +94,12 @@ _KIND_PID = {
     # pin inside the warmup/rewarm span that paid for them, on a
     # "compile" sub-lane). Old journals without them export unchanged.
     "compile_event": "sup",
+    # Fleet router records (ISSUE 16, docs/SERVING.md "Fleet router"):
+    # one router_route per northbound request (its ms renders as a
+    # slice), instants for redirects/backend state transitions, and the
+    # config header. Old journals without them export unchanged.
+    "router_config": "router", "router_route": "router",
+    "router_redirect": "router", "router_backend_state": "router",
     "gate_pass": "tune", "gate_fail": "tune",
     "step": "train", "ckpt": "train", "rollback": "train", "resume": "train",
     "wedge_detected": "journal", "recycle": "journal", "reprobe": "journal",
@@ -109,6 +120,8 @@ _KIND_DUR_FIELD = {
     "sup_promote": "ms",
     "mesh_probation": "ms",
     "compile_event": "ms",
+    # A routed request's full router-side wall (receive -> response).
+    "router_route": "ms",
 }
 # Gauge-bearing record kinds -> the numeric fields that become counter
 # series. Each record emits one "C" (counter) event per listed field, so

@@ -76,6 +76,12 @@ ERROR_BUDGET = 0.01
 # they tile the incident wall time exactly).
 TRIP_PHASES = ("detect", "degrade", "compile", "rewarm", "reshard", "replay")
 GROWBACK_PHASES = ("probation", "spot_check", "compile", "promote")
+# Router backend-down incidents (ISSUE 16, serving.router): a host dies,
+# the probes notice (detect), in-flight traffic drains into failures and
+# redirects (drain is the remainder phase), subsequent traffic redirects
+# away (redirect), and the restarted backend waits out probation
+# (readmit). Summing to the outage wall by the same _clamped_phases rule.
+BACKEND_DOWN_PHASES = ("detect", "drain", "redirect", "readmit")
 
 _DTYPE_TO_LEDGER = {
     "float32": "fp32", "fp32": "fp32",
@@ -305,11 +311,12 @@ class Incident:
             f"{k}={'unattributed' if v is None else format(v, '.1f')}"
             for k, v in self.phases.items()
         )
-        head = (
-            f"trip {self.cause} @{self.entry}"
-            if self.kind == "trip"
-            else f"growback -> {self.entry}"
-        )
+        if self.kind == "trip":
+            head = f"trip {self.cause} @{self.entry}"
+        elif self.kind == "backend_down":
+            head = f"backend_down {self.entry} ({self.cause})"
+        else:
+            head = f"growback -> {self.entry}"
         return f"#{self.index} {head} wall={self.wall_ms:.1f}ms  {parts}"
 
 
@@ -539,6 +546,56 @@ def incidents_from_records(records: List[dict]) -> List[Incident]:
                 trace_id=trace_id,
             )
         )
+
+    # ---- router backend-down windows (ISSUE 16, serving.router) ----
+    # A window opens at a real state transition to "down" and closes at
+    # the matching readmission to "up" (endpoint replacements journal
+    # frm == to and are not transitions; a still-down backend at journal
+    # end is an OPEN outage, not an incident row). The incident starts
+    # detect_ms BEFORE the down verdict — the detection latency is part
+    # of the outage, not prologue.
+    state_recs = [
+        (i, r)
+        for i, r in enumerate(records)
+        if r.get("kind") == "router_backend_state"
+        and r.get("frm") != r.get("to")
+    ]
+    redirect_recs = [
+        (i, r) for i, r in enumerate(records) if r.get("kind") == "router_redirect"
+    ]
+    open_down: Dict[str, Tuple[int, dict]] = {}
+    for i, r in state_recs:
+        b = str(r.get("backend") or "")
+        if r.get("to") == "down":
+            open_down.setdefault(b, (i, r))
+        elif r.get("to") == "up" and b in open_down:
+            oi, orec = open_down.pop(b)
+            detect = float(orec.get("detect_ms") or 0.0)
+            t_down = float(orec.get("t_ms") or 0.0)
+            t_up = float(r.get("t_ms") or 0.0)
+            t0 = max(0.0, t_down - detect)
+            wall = max(0.0, t_up - t0)
+            red_ts = [
+                float(rr.get("t_ms") or 0.0)
+                for j, rr in redirect_recs
+                if oi < j < i and rr.get("frm") == b
+            ]
+            raw: Dict[str, Optional[float]] = {
+                "detect": detect,
+                "redirect": max(0.0, max(red_ts) - t_down) if red_ts else 0.0,
+                "readmit": float(r.get("probation_ms") or 0.0),
+            }
+            incidents.append(
+                Incident(
+                    kind="backend_down",
+                    index=len(incidents) + 1,
+                    entry=b,
+                    cause=str(orec.get("reason") or "probe_failed"),
+                    wall_ms=wall,
+                    phases=_clamped_phases(wall, BACKEND_DOWN_PHASES, raw, "drain"),
+                    t0_ms=t0,
+                )
+            )
     return incidents
 
 
@@ -1046,23 +1103,46 @@ def health_from_records(records: List[dict]) -> HealthReport:
         delivered_device_ms=delivered,
         devices=devices,
         duration_ms=duration,
+        # Router backend hysteresis (ISSUE 16) folds into the same
+        # counters as the device-level ElasticPool records: a backend
+        # process flapping into quarantine and a device flapping out of
+        # the mesh are one fleet-health story at two granularities.
         flaps=sum(
             int(r.get("flaps") or 0)
             for r in records
             if r.get("kind") == "mesh_quarantine"
+            or (
+                r.get("kind") == "router_backend_state"
+                and r.get("to") == "quarantined"
+            )
         ),
         quarantines=sum(
-            1 for r in records if r.get("kind") == "mesh_quarantine"
+            1
+            for r in records
+            if r.get("kind") == "mesh_quarantine"
+            or (
+                r.get("kind") == "router_backend_state"
+                and r.get("to") == "quarantined"
+            )
         ),
         probation_enters=sum(
             1
             for r in records
-            if r.get("kind") == "mesh_probation" and r.get("event") == "enter"
+            if (r.get("kind") == "mesh_probation" and r.get("event") == "enter")
+            or (
+                r.get("kind") == "router_backend_state"
+                and r.get("to") == "probation"
+            )
         ),
         probation_passes=sum(
             1
             for r in records
-            if r.get("kind") == "mesh_probation" and r.get("event") == "pass"
+            if (r.get("kind") == "mesh_probation" and r.get("event") == "pass")
+            or (
+                r.get("kind") == "router_backend_state"
+                and r.get("to") == "up"
+                and r.get("reason") == "readmit"
+            )
         ),
         compile=compile_attribution(records),
         n_records=len(records),

@@ -49,6 +49,14 @@ fires would report "recovery path exercised" without exercising anything):
                       ``drain``), one half-cycle per supervised step. The
                       pool must quarantine the flapper (``mesh_quarantine``)
                       instead of oscillating the mesh.
+    host_loss         serving.fleet (router tier) — SIGKILL one seeded
+                      backend PROCESS mid-load (victim = seed % n, via
+                      ``fleet.maybe_host_loss``). The router must fail the
+                      dead host's in-flight requests attributably, redirect
+                      subsequent traffic within each request's retry
+                      budget, and re-admit the restarted backend only
+                      through probation — the process-boundary half of the
+                      device_loss story.
     kernel_compile    run CLI build step (pallas tier) — Mosaic lowering
                       failure; degrades Pallas -> XLA reference tier.
     subprocess_wedge  harness.run_case — the classic wedged-tunnel capture
@@ -90,6 +98,7 @@ KNOWN_SITES = (
     "mesh_shrink",
     "device_rejoin",
     "flap",
+    "host_loss",
 )
 
 

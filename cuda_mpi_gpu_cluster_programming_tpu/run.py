@@ -208,6 +208,27 @@ def make_parser() -> argparse.ArgumentParser:
         "line",
     )
     p.add_argument(
+        "--route",
+        type=int,
+        default=0,
+        metavar="N",
+        help="with --serve: fleet mode — spawn N backend serving PROCESSES "
+        "(serving.fleet) behind a FleetRouter and drive the HTTP client "
+        "fleet through the router instead of one in-process server "
+        "(docs/SERVING.md 'Fleet router'). Deterministic crc32-of-rid "
+        "routing, probe-driven up/probation/quarantine hysteresis, "
+        "journaled retry-with-redirect; prints machine-parsed 'Route "
+        "fleet:'/'Route load:'/'Route class:'/'Route:' lines. Ignores "
+        "--config et al. (backends build their own v1_jit servers)",
+    )
+    p.add_argument(
+        "--route-dir",
+        default="logs/route",
+        help="with --route: journal directory — one backend_<i>.jsonl per "
+        "backend plus router.jsonl, exportable as ONE stitched timeline "
+        "via 'observability export --journal DIR'",
+    )
+    p.add_argument(
         "--traffic-shape",
         default="",
         help="with --serve: traffic-shaped load instead of plain Poisson — "
@@ -292,6 +313,114 @@ def _chaos_build_faults(exec_cfg) -> None:
             )
     if exec_cfg.tier == "pallas":
         ch.maybe_raise("kernel_compile", f"{exec_cfg.key} Mosaic lowering")
+
+
+def _run_route(args, blocks_cfg) -> int:
+    """Fleet mode (--serve --route N): N backend serving processes behind
+    a FleetRouter, the HTTP client fleet driven through the router, and
+    the journals (one per backend + the router's) stitched from one
+    directory. With host_loss chaos armed, the seeded backend is
+    SIGKILLed mid-load, restarted after the load window, and must
+    re-admit through probation — the CLI face of the acceptance drill
+    (docs/SERVING.md 'Fleet router')."""
+    import threading
+    import time as _time
+    from pathlib import Path
+
+    from .resilience.policy import RetryPolicy
+    from .serving.batcher import power_of_two_buckets
+    from .serving.fleet import BackendFleet, maybe_host_loss
+    from .serving.frontend import http_fleet_load
+    from .serving.router import UP, FleetRouter, RouterConfig
+    from .serving.traffic import default_class_mix
+
+    route_dir = Path(args.route_dir)
+    route_dir.mkdir(parents=True, exist_ok=True)
+    fleet = BackendFleet(
+        args.route,
+        route_dir,
+        height=blocks_cfg.in_height,
+        width=blocks_cfg.in_width,
+        max_batch=args.serve_max_batch,
+    )
+    router = None
+    killed = [None]
+    try:
+        fleet.start()
+        router = FleetRouter(
+            fleet.urls(),
+            RouterConfig(
+                probe_interval_s=0.1,
+                probe_timeout_s=2.0,
+                fail_k=2,
+                readmit_m=2,
+                retry=RetryPolicy(
+                    max_retries=3, base_delay_s=0.02, max_delay_s=0.25,
+                    jitter=0.1,
+                ),
+                default_deadline_s=args.serve_deadline_s or None,
+                journal_path=str(route_dir / "router.jsonl"),
+            ),
+        ).start()
+        print(
+            f"Route fleet: n={args.route} url={router.url} dir={route_dir}"
+        )
+        mix = list(
+            default_class_mix(power_of_two_buckets(args.serve_max_batch))
+        )
+        # host_loss chaos fires mid-window from a timer — the load keeps
+        # offering while the victim dies, which is the point.
+        timer = threading.Timer(
+            max(0.05, args.serve_duration / 2),
+            lambda: killed.__setitem__(0, maybe_host_loss(fleet)),
+        )
+        timer.start()
+        t_kill = _time.monotonic()
+        report = http_fleet_load(
+            router.url,
+            (
+                blocks_cfg.in_height,
+                blocks_cfg.in_width,
+                blocks_cfg.in_channels,
+            ),
+            shape=args.traffic_shape or "steady",
+            rate_rps=args.serve_rate,
+            duration_s=args.serve_duration,
+            classes=mix,
+            seed=args.seed,
+        )
+        timer.cancel()
+        recovery_ms = None
+        if killed[0] is not None:
+            idx = killed[0]
+            print(f"Route host loss: killed=b{idx} (chaos host_loss)")
+            router.replace_backend(idx, fleet.restart(idx))
+            deadline = _time.monotonic() + 60.0
+            while (
+                _time.monotonic() < deadline
+                and router.backend_states()[f"b{idx}"] != UP
+            ):
+                _time.sleep(0.05)
+            if router.backend_states()[f"b{idx}"] == UP:
+                recovery_ms = (_time.monotonic() - t_kill) * 1e3
+        print(f"Route load: {report.summary()}")
+        rrep = router.report()
+        for line in rrep.class_lines():
+            print(line)
+        if recovery_ms is not None:
+            print(f"Route recovery: killed=b{killed[0]} ms={recovery_ms:.0f}")
+        print(f"Route: {rrep.summary()}")
+    finally:
+        if router is not None:
+            router.stop()
+        fleet.stop()
+    from .observability.health import health_from_journal
+
+    try:
+        print(f"Health: {health_from_journal(route_dir).summary_line()}")
+    except Exception as e:  # noqa — the fold is evidence, not the verdict
+        print(f"Health: unavailable ({type(e).__name__}: {e})")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -577,6 +706,11 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if args.route:
+            # Fleet mode: N backend PROCESSES behind the router — the
+            # single-process build below is bypassed entirely (each
+            # backend owns its server; the router owns the accounting).
+            return _run_route(args, blocks_cfg)
         from .serving.loadgen import run_load, run_shaped_load
         from .serving.server import InferenceServer, ServeConfig
         from .serving.traffic import default_class_mix, parse_shape, slo_policy

@@ -8,11 +8,18 @@ wrapping ``configs.build_forward`` — or the PR 5 elastic supervisor as
 the in-service degradation ladder — that journals every batch
 (``server``), a load generator with Poisson AND traffic-shaped arrivals
 plus latency-percentile reporting and the saturation sweep (``loadgen``,
-``traffic``), and the HTTP network front end over the admission queue
-with its threaded client fleet (``frontend``).
+``traffic``), the HTTP network front end over the admission queue with
+its threaded client fleet (``frontend``), and the fleet tier above N of
+those: a deterministic crc32 router with retry-with-redirect and
+probe-driven backend hysteresis (``router``) over N real backend
+processes spawned/killed/restarted across a process boundary
+(``fleet`` — the ``host_loss`` chaos drill's stage).
 
 Layering rule: ``queue``/``batcher``/``loadgen``/``traffic``/``slo`` are
 stdlib+numpy only (no jax import — the same rule as
 ``resilience.policy``); only ``server`` pays the backend import, at
-dispatch-build time, and ``frontend`` rides on ``server``.
+dispatch-build time, and ``frontend`` rides on ``server``. ``router``
+is stdlib-ONLY (transport and policy, never compute); ``fleet``'s
+parent half is stdlib-only too — the jax import happens in the spawned
+child processes.
 """

@@ -1,0 +1,298 @@
+"""Backend fleet launcher: N serving processes behind one FleetRouter.
+
+The CPU-testable stand-in ROADMAP item 3 names for one-frontend-per-host
+over ``parallel/deploy.py``: each backend is a REAL OS process (module
+entry ``python -m cuda_mpi_gpu_cluster_programming_tpu.serving.fleet
+--child ...``) building its own :class:`~.server.InferenceServer` +
+:class:`~.frontend.ServingFrontend` on an ephemeral port and announcing
+readiness with one machine-parsed line::
+
+    FLEET_READY name=b0 port=41231
+
+so host loss is a process fault, not a thread fault — the drills that
+matter (``host_loss`` chaos SIGKILLs a backend mid-load) exercise a
+kill(2) across a process boundary, the thing every earlier drill
+(device loss, SDC, flap) could not: those all die *inside* one process.
+
+Each backend writes its own journal (``<journal_dir>/backend_<i>.jsonl``)
+beside the router's (``<journal_dir>/router.jsonl``);
+``observability.export.load_records`` on the directory stitches all of
+them into one Perfetto timeline.
+
+The parent-side :class:`BackendFleet` spawns/kills/restarts children:
+``kill(i)`` is SIGKILL (host loss — no goodbye), ``restart(i)`` respawns
+the slot on a NEW ephemeral port (a replacement host) and returns the
+url for :meth:`FleetRouter.replace_backend` — the slot's hash-ring
+position never moves, and the restarted backend still re-admits through
+the router's probation.
+
+:func:`maybe_host_loss` is the chaos consumer: the seeded ``host_loss``
+site picks its victim as ``seed % n`` — deterministic per spec, like
+every other chaos site.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..resilience import chaos
+
+READY_PREFIX = "FLEET_READY"
+_PKG_ROOT = Path(__file__).resolve().parents[2]  # repo root (package parent)
+
+
+class FleetError(RuntimeError):
+    pass
+
+
+class BackendProc:
+    """One spawned backend: process handle + announced endpoint."""
+
+    def __init__(
+        self, index: int, proc: subprocess.Popen, port: int, journal_path: str
+    ):
+        self.index = index
+        self.name = f"b{index}"
+        self.proc = proc
+        self.port = port
+        self.journal_path = journal_path
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+def _read_ready(proc: subprocess.Popen, timeout_s: float) -> int:
+    """Scan child stdout for the READY line (bounded — a backend that
+    never comes up is a spawn failure, not a hang). The scan runs in a
+    helper thread so a wedged child can't block the launcher past its
+    deadline."""
+    found: List[int] = []
+    err: List[str] = []
+
+    def _scan() -> None:
+        tail: List[str] = []
+        for line in proc.stdout:  # type: ignore[union-attr]
+            tail.append(line.rstrip()[-200:])
+            if line.startswith(READY_PREFIX):
+                for tok in line.split():
+                    if tok.startswith("port="):
+                        found.append(int(tok[5:]))
+                        return
+        err.append("; ".join(tail[-5:]))
+
+    t = threading.Thread(target=_scan, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not found:
+        proc.kill()
+        tail = err[0] if err else "no output"
+        raise FleetError(
+            f"backend never announced {READY_PREFIX} within {timeout_s}s "
+            f"(rc={proc.poll()}, tail: {tail})"
+        )
+    return found[0]
+
+
+class BackendFleet:
+    """Spawn and manage N backend serving processes.
+
+    ``journal_dir`` receives one ``backend_<i>.jsonl`` per backend (and
+    is where callers point the router's own journal, so one directory
+    exports as one stitched timeline). Children inherit the environment
+    plus ``JAX_PLATFORMS`` and a PYTHONPATH entry for the repo root, so
+    the fleet spawns correctly from any cwd.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        journal_dir,
+        *,
+        height: int = 63,
+        width: int = 63,
+        max_batch: int = 4,
+        config: str = "v1_jit",
+        slo: bool = True,
+        spawn_timeout_s: float = 240.0,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        if n < 1:
+            raise ValueError("fleet needs n >= 1 backends")
+        self.n = n
+        self.journal_dir = Path(journal_dir)
+        self.height, self.width = height, width
+        self.max_batch, self.config, self.slo = max_batch, config, slo
+        self.spawn_timeout_s = spawn_timeout_s
+        self._extra_env = dict(env or {})
+        self.backends: List[Optional[BackendProc]] = [None] * n
+
+    def _spawn(self, index: int) -> BackendProc:
+        jpath = str(self.journal_dir / f"backend_{index}.jsonl")
+        cmd = [
+            sys.executable, "-m",
+            "cuda_mpi_gpu_cluster_programming_tpu.serving.fleet",
+            "--child", "--name", f"b{index}",
+            "--config", self.config,
+            "--height", str(self.height), "--width", str(self.width),
+            "--max-batch", str(self.max_batch),
+            "--journal", jpath, "--port", "0",
+        ]
+        if self.slo:
+            cmd.append("--slo")
+        env = {**os.environ, **self._extra_env}
+        env["PYTHONPATH"] = (
+            str(_PKG_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        # Chaos must not recurse into children: the parent owns the
+        # host_loss budget; a child re-drawing it would double-fire.
+        env.pop(chaos.CHAOS_ENV, None)
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        port = _read_ready(proc, self.spawn_timeout_s)
+        return BackendProc(index, proc, port, jpath)
+
+    def start(self) -> "BackendFleet":
+        self.journal_dir.mkdir(parents=True, exist_ok=True)
+        # Spawn all children first (they warm up concurrently), then
+        # collect READY lines — fleet bring-up costs one warmup, not N.
+        for i in range(self.n):
+            self.backends[i] = self._spawn(i)
+        return self
+
+    def urls(self) -> List[str]:
+        out = []
+        for b in self.backends:
+            if b is None:
+                raise FleetError("fleet not started")
+            out.append(b.url)
+        return out
+
+    def kill(self, index: int) -> None:
+        """SIGKILL — host loss, no drain, no goodbye. The router finds
+        out the way production does: requests die and probes miss."""
+        b = self.backends[index]
+        if b is not None:
+            b.proc.kill()
+            b.proc.wait(10.0)
+
+    def restart(self, index: int) -> str:
+        """Respawn a dead slot on a new ephemeral port (a replacement
+        host keeps the slot's ring position, not its address). Returns
+        the new url for ``FleetRouter.replace_backend``."""
+        old = self.backends[index]
+        if old is not None and old.alive:
+            raise FleetError(f"backend {index} still alive; kill it first")
+        self.backends[index] = self._spawn(index)
+        return self.backends[index].url
+
+    def stop(self) -> None:
+        for b in self.backends:
+            if b is None or not b.alive:
+                continue
+            b.proc.terminate()
+        for b in self.backends:
+            if b is None:
+                continue
+            try:
+                b.proc.wait(10.0)
+            except subprocess.TimeoutExpired:
+                b.proc.kill()
+                b.proc.wait(10.0)
+
+    def __enter__(self) -> "BackendFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def maybe_host_loss(fleet: BackendFleet) -> Optional[int]:
+    """Fire the seeded ``host_loss`` chaos site if armed: SIGKILL one
+    backend chosen as ``seed % n`` (deterministic per CHAOS_SPEC, the
+    same discipline as every other site). Returns the killed index, or
+    None when the site didn't fire."""
+    ch = chaos.active()
+    if ch is None or not ch.draw("host_loss"):
+        return None
+    idx = ch.spec.seed % fleet.n
+    fleet.kill(idx)
+    return idx
+
+
+# ------------------------------------------------------------ child entry
+
+
+def _child_main(argv: List[str]) -> int:
+    """One backend process: InferenceServer + ServingFrontend on an
+    ephemeral port, READY line on stdout, then park until killed."""
+    import argparse
+    import dataclasses
+
+    ap = argparse.ArgumentParser(prog="serving.fleet --child")
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--name", default="b0")
+    ap.add_argument("--config", default="v1_jit")
+    ap.add_argument("--height", type=int, default=63)
+    ap.add_argument("--width", type=int, default=63)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--journal", default="")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--slo", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ..models.alexnet import BLOCKS12
+    from .frontend import ServingFrontend
+    from .server import InferenceServer, ServeConfig
+
+    model_cfg = dataclasses.replace(
+        BLOCKS12, in_height=args.height, in_width=args.width
+    )
+    slo = None
+    if args.slo:
+        from .batcher import power_of_two_buckets
+        from .traffic import default_class_mix, slo_policy
+
+        slo = slo_policy(
+            default_class_mix(power_of_two_buckets(args.max_batch))
+        )
+    srv = InferenceServer(
+        ServeConfig(
+            config=args.config,
+            max_batch=args.max_batch,
+            model_cfg=model_cfg,
+            journal_path=args.journal or None,
+            slo=slo,
+        )
+    )
+    srv.start()
+    fe = ServingFrontend(srv, port=args.port).start()
+    print(f"{READY_PREFIX} name={args.name} port={fe.port}", flush=True)
+    try:
+        while True:  # host loss is SIGKILL; orderly stop is SIGTERM
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fe.stop()
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_child_main(sys.argv[1:]))

@@ -314,6 +314,12 @@ _HOT_LOOP_FILES = {
     # blocking socket call inside a timed region there is a per-request
     # latency tax.
     "frontend.py", "traffic.py", "slo.py",
+    # The fleet router tier (ISSUE 16): every northbound request crosses
+    # the router's handler and redirect loop, and the probe loop's
+    # latency IS the detection time — the same no-stray-waits discipline
+    # as the front end, plus the fleet launcher whose READY scan gates
+    # drill bring-up.
+    "router.py", "fleet.py",
 }
 _HOT_LOOP_DIRS = {"observability"}
 

@@ -242,6 +242,44 @@ else
     say "FLEET-HEALTH GATE FAILED (rc=$HEALTH_RC) — blown SLO error budget (rc 3) or unreadable journal (rc 2); judge it before chip time (python -m cuda_mpi_gpu_cluster_programming_tpu.observability health --journal logs/serve_smoke_${FTS}.jsonl)"
 fi
 
+say "fleet-router host-loss smoke (N backend PROCESSES behind the router, SIGKILL + redirect + probation re-admission — docs/SERVING.md 'Fleet router')"
+# The process-boundary half of the device-loss story is PROVEN before
+# chip time, same policy as every drill above: BENCH_MODE=route spawns a
+# real 2-process fleet behind the router, SIGKILLs the seeded backend
+# between the pre/post load windows, and must (a) keep the router's
+# per-class accounting CLOSED (ok+shed+failed+rejected+unroutable ==
+# offered), (b) keep serving through the loss (post_loss_img_s > 0 —
+# redirects ride each request's own deadline budget), and (c) re-admit
+# the restarted process through probation (recovery_ms non-null). A
+# fleet that can't survive one host on an idle CPU has no business
+# fronting chip traffic.
+if timeout 600 env JAX_PLATFORMS=cpu \
+    BENCH_MODE=route BENCH_ROUTE_N=2 BENCH_ROUTE_RATE=20 \
+    BENCH_ROUTE_DURATION=1.5 \
+    BENCH_ROUTE_JOURNAL="logs/route_smoke_${FTS}" \
+    python bench.py 2>>"$LOG" | tail -1 | tee -a "$LOG" \
+    | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+ok = (not d.get('error')
+      and d.get('accounting_closed') is True
+      and d.get('pre_loss_img_s', 0) > 0
+      and d.get('post_loss_img_s', 0) > 0
+      and d.get('killed') is not None
+      and d.get('recovery_ms') is not None)
+sys.exit(0 if ok else 1)"; then
+    say "router smoke OK (host killed mid-run, accounting closed, served through the loss, restart re-admitted through probation; journals: logs/route_smoke_${FTS}/)"
+else
+    say "ROUTER SMOKE FAILED — fleet tier broken; fix before fronting chip traffic this window (journals: logs/route_smoke_${FTS}/)"
+fi
+# Stitched Perfetto timeline over the WHOLE fleet directory (router +
+# one journal per backend): the outage renders as a backend_down
+# incident lane beside each backend's serve records.
+timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
+    export --journal "logs/route_smoke_${FTS}" \
+    --out "logs/trace_route_${FTS}.json" 2>&1 | tee -a "$LOG" \
+    || say "route trace export failed — see $LOG"
+
 say "perf-regression gate over the committed BENCH trajectory (echo-aware; a >10% surviving regression blocks the window)"
 # The gate that turns bench_report from a viewer into CI: last_good
 # echoes are excluded attributably (the r02-r05 wedge trail), and any

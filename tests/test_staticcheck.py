@@ -927,3 +927,25 @@ def test_blocking_socket_scope_and_shipped_serving_clean():
         assert findings_for(
             ROOT / serving / mod, "blocking-socket-call-in-timed-region"
         ) == []
+
+
+def test_router_tier_in_hot_loop_scope_and_clean():
+    """ISSUE 16 satellite: the fleet router tier (serving/router.py +
+    serving/fleet.py) joins the hot-loop scope — its probe/forward waits
+    are timed regions — and ships clean: the deliberate socket waits
+    (the probe IS the health measurement; the hop wait IS the redirect
+    budget) carry their reviewed # noqa."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        BlockingSocketInTimedRegionRule,
+        HostSyncInHotLoopRule,
+    )
+
+    serving = "cuda_mpi_gpu_cluster_programming_tpu/serving"
+    for rule in (HostSyncInHotLoopRule(), BlockingSocketInTimedRegionRule()):
+        assert rule.applies(Path(f"{serving}/router.py"))
+        assert rule.applies(Path(f"{serving}/fleet.py"))
+    for mod in ("router.py", "fleet.py"):
+        assert findings_for(ROOT / serving / mod, "host-sync-in-hot-loop") == []
+        assert findings_for(
+            ROOT / serving / mod, "blocking-socket-call-in-timed-region"
+        ) == []
