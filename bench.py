@@ -197,20 +197,53 @@ def _error_json(msg: str, platform: str = "unknown") -> str:
 
 
 def _stage_breakdown(tier: str, dtype: str, params, x, platform: str,
-                     model_cfg=None) -> dict:
+                     model_cfg=None, plan=None) -> dict:
     """The per-stage ``breakdown`` sub-object (docs/OBSERVABILITY.md):
     attribution at the sentinel tap boundaries via timed staged
     re-execution, strictly after the headline measurement. Degrades to a
     visible note instead of mislabeling: int8w has no staged-chain
     analogue, and interpret-mode Pallas staging on CPU would attribute
     tracing overhead, not kernels. BENCH_BREAKDOWN=0 disables,
-    BENCH_BREAKDOWN_REPEATS sizes the per-prefix chains."""
+    BENCH_BREAKDOWN_REPEATS sizes the per-prefix chains.
+
+    ``plan``: the TunePlan the row measured under. When the resolved
+    variants fuse whole blocks (``fuse="block"`` megakernels), the honest
+    vocabulary is block1/block2 — attribution routes to
+    ``attribute_blocks`` and the sub-object carries
+    ``granularity="block"``; a fused pass has no interior stage
+    boundaries, and faking five stage rows from a two-kernel pass would
+    be attribution fiction."""
     if dtype not in ("fp32", "bf16"):
         return {"skipped": f"no staged-chain analogue for dtype {dtype!r}"}
     if tier == "pallas" and platform == "cpu":
         return {"skipped": "pallas staging runs interpret-mode on cpu "
                            "(attribute on chip)"}
     try:
+        repeats = int(os.environ.get("BENCH_BREAKDOWN_REPEATS", "3"))
+        if tier == "pallas":
+            from cuda_mpi_gpu_cluster_programming_tpu.configs import (
+                _resolve_variants,
+            )
+            from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_model import (
+                _layer_variants,
+            )
+
+            kv = _resolve_variants(plan)
+            if any(
+                _layer_variants(kv, n).fuse == "block"
+                for n in ("conv1", "conv2")
+            ):
+                from cuda_mpi_gpu_cluster_programming_tpu.observability.stages import (  # noqa: E501
+                    attribute_blocks,
+                )
+
+                return attribute_blocks(
+                    params, x, model_cfg,
+                    compute=dtype,
+                    variants=kv,
+                    repeats=repeats,
+                    warmup=1,
+                ).to_obj()
         from cuda_mpi_gpu_cluster_programming_tpu.observability.stages import (
             attribute_stages,
         )
@@ -219,7 +252,7 @@ def _stage_breakdown(tier: str, dtype: str, params, x, platform: str,
             params, x, model_cfg,
             tier=tier,
             compute=dtype,
-            repeats=int(os.environ.get("BENCH_BREAKDOWN_REPEATS", "3")),
+            repeats=repeats,
             warmup=1,
         ).to_obj()
     except Exception as e:  # evidence, not the headline — degrade visibly
@@ -412,7 +445,7 @@ def _child() -> int:
             # per_pass_ms is the sums-to-total contract) — what the
             # paper's tables report, machine-comparable across BENCH_r*.
             out["breakdown"] = _stage_breakdown(
-                REGISTRY[cfg_key].tier, DTYPE, params, x, platform
+                REGISTRY[cfg_key].tier, DTYPE, params, x, platform, plan=plan
             )
             # ... and the roofline join (ISSUE 13): per-stage MFU /
             # achieved GB/s / bound verdicts + the predicted fused-block

@@ -200,17 +200,53 @@ def build_forward(
     except Exception:
         pass
     if pol.quantized:
-        if exec_cfg.model != "blocks12" or exec_cfg.strategy != "single":
+        if exec_cfg.model != "blocks12" or exec_cfg.strategy not in (
+            "single", "halo", "staged_halo", "replicated"
+        ):
             raise ValueError(
-                f"policy {pol.name!r} supports the single-device Blocks 1-2 "
-                f"tiers only (config {exec_cfg.key!r} is "
-                f"{exec_cfg.model}/{exec_cfg.strategy}); quantized sharded "
-                "forwards are an open ROADMAP item"
+                f"policy {pol.name!r} supports the Blocks 1-2 single-device, "
+                f"halo-sharded, and replicated tiers only (config "
+                f"{exec_cfg.key!r} is {exec_cfg.model}/{exec_cfg.strategy}); "
+                "quantized tensor-parallel and full-AlexNet forwards are "
+                "open ROADMAP items"
             )
         from .models.alexnet import BLOCKS12 as _B12
-        from .precision.quantize import forward_blocks12_int8w
 
         mcfg = model_cfg or _B12
+        if exec_cfg.strategy in ("halo", "staged_halo", "replicated"):
+            # Sharded int8w rungs: int8 values + per-channel scales ride the
+            # replicated param tree; each rung is expected to re-screen via
+            # precision.gate.ToleranceGate.screen_sharded before its rows
+            # publish (scripts/on_heal.sh wires this on-chip).
+            need = n_shards
+            if mesh is None and jax.device_count() < need:
+                raise ValueError(
+                    f"config {exec_cfg.key!r} with {n_shards} shards needs "
+                    f"{need} devices, have {jax.device_count()} (use "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count=N on "
+                    f"CPU to fake a mesh)"
+                )
+            if exec_cfg.strategy == "replicated":
+                from .parallel.replicated import build_replicated_forward
+
+                fwd = build_replicated_forward(
+                    mcfg, n_shards, mesh=mesh, quantized=True
+                )
+            else:
+                from .parallel.sharded import build_sharded_forward
+
+                fwd = build_sharded_forward(
+                    mcfg,
+                    n_shards,
+                    mesh=mesh,
+                    tier=exec_cfg.tier,
+                    staged=(exec_cfg.strategy == "staged_halo"),
+                    plan=plan,
+                    quantized=True,
+                )
+            return _observed(fwd, exec_cfg, pol.name, n_shards)
+        from .precision.quantize import forward_blocks12_int8w
+
         kv = _resolve_variants(plan) if exec_cfg.tier == "pallas" else None
         tier = exec_cfg.tier
         return _observed(

@@ -96,6 +96,11 @@ class RoundRow:
     echo_of: str = ""  # origin round name when provenance is an echo
     per_pass_ms: Optional[float] = None
     stages: Optional[Dict[str, float]] = None
+    # "stage" (sentinel-boundary rows) vs "block" (fuse="block" megakernel
+    # rows, block1/block2 vocabulary). Stage diffs only ever compare rows
+    # of the SAME granularity: a fused block1 time against a staged conv1
+    # time is not a regression signal, it's a vocabulary collision.
+    granularity: str = "stage"
     error: str = ""
 
     @property
@@ -117,6 +122,7 @@ class RoundRow:
             "echo_of": self.echo_of or None,
             "per_pass_ms": self.per_pass_ms,
             "stages": self.stages,
+            "granularity": self.granularity,
             "error": self.error or None,
         }
 
@@ -153,6 +159,11 @@ def load_rounds(paths) -> List[RoundRow]:
             provenance="none",
             per_pass_ms=float(per_pass) if isinstance(per_pass, (int, float)) else None,
             stages=stages,
+            granularity=(
+                str(bd.get("granularity") or "stage")
+                if isinstance(bd, dict)
+                else "stage"
+            ),
             error=str(obj.get("error") or ""),
         )
         if isinstance(v, (int, float)) and v > 0:
@@ -252,9 +263,10 @@ class GateVerdict:
                 bits.append(f"error={r.error[:60]!r}")
             if r.stages:
                 worst = max(r.stages, key=lambda s: r.stages[s])
+                gran = "" if r.granularity == "stage" else f" {r.granularity}-granularity"
                 bits.append(
                     f"breakdown[{len(r.stages)} stages, top {worst}="
-                    f"{r.stages[worst]:.3f} ms]"
+                    f"{r.stages[worst]:.3f} ms{gran}]"
                 )
             lines.append(" ".join(bits))
         if self.regressions:
@@ -272,18 +284,23 @@ def evaluate(paths, threshold: float = THRESHOLD) -> GateVerdict:
 
     Headline: a later measured value below ``(1 - threshold)`` × the
     previous measured value is a regression. Stages: between consecutive
-    breakdown-carrying measured rounds, any stage above
-    ``(1 + threshold)`` × its predecessor is a regression. Echo rounds
-    are excluded from both chains (and reported via the verdict)."""
+    breakdown-carrying measured rounds OF THE SAME GRANULARITY, any stage
+    above ``(1 + threshold)`` × its predecessor is a regression — a
+    staged round and a ``fuse="block"`` megakernel round are distinct
+    variants whose per-stage chains diff independently (ISSUE 17: a
+    fused block1 row must never diff against a staged conv1 row). Echo
+    rounds are excluded from every chain (and reported via the
+    verdict)."""
     rows = load_rounds(paths)
     regressions: List[Regression] = []
     compared = 0
     prev: Optional[RoundRow] = None
-    prev_stages: Optional[Tuple[str, Dict[str, float]]] = None
+    prev_stages_by_gran: Dict[str, Tuple[str, Dict[str, float]]] = {}
     for r in rows:
         if r.is_echo:
             continue
         if r.stages and not r.is_echo:
+            prev_stages = prev_stages_by_gran.get(r.granularity)
             if prev_stages is not None:
                 frm_name, p_stages = prev_stages
                 for s, ms in r.stages.items():
@@ -301,7 +318,7 @@ def evaluate(paths, threshold: float = THRESHOLD) -> GateVerdict:
                                 provenance=r.provenance,
                             )
                         )
-            prev_stages = (r.name, r.stages)
+            prev_stages_by_gran[r.granularity] = (r.name, r.stages)
         if not r.measured:
             continue
         if prev is not None:

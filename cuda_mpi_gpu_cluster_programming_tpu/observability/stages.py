@@ -62,7 +62,13 @@ def sentinel_stage_fns(cfg=None, tier: str = "reference") -> List[Tuple[str, Cal
 @dataclasses.dataclass(frozen=True)
 class StageAttribution:
     """One attribution pass: per-stage ms (telescoped prefix differences),
-    the raw prefix times, and the full-chain total the stages sum to."""
+    the raw prefix times, and the full-chain total the stages sum to.
+
+    ``granularity``: "stage" (the five sentinel boundaries) or "block"
+    (block1/block2 — the honest vocabulary for ``fuse="block"`` rows,
+    where a fused pass has no interior boundaries to tap; the sub-object
+    names its source so a block row can never be mistaken for a faked
+    per-stage split)."""
 
     stages: Tuple[Tuple[str, float], ...]  # (name, attributed ms), in order
     prefix_ms: Tuple[float, ...]  # t(prefix_1) .. t(prefix_5) == total
@@ -70,6 +76,7 @@ class StageAttribution:
     batch: int
     tier: str
     compute: str
+    granularity: str = "stage"
 
     @property
     def stage_sum_ms(self) -> float:
@@ -82,10 +89,14 @@ class StageAttribution:
             "stages": {name: round(ms, 4) for name, ms in self.stages},
             "stage_sum_ms": round(self.stage_sum_ms, 4),
             "total_ms": round(self.total_ms, 4),
-            "method": "prefix-diff",
+            "method": (
+                "prefix-diff" if self.granularity == "stage"
+                else "prefix-diff/megakernel-blocks"
+            ),
             "tier": self.tier,
             "compute": self.compute,
             "batch": self.batch,
+            "granularity": self.granularity,
         }
 
 
@@ -168,4 +179,103 @@ def attribute_stages(
         batch=int(x.shape[0]),
         tier=tier,
         compute=compute,
+    )
+
+
+@off_timed_path
+def attribute_blocks(
+    params,
+    x,
+    cfg=None,
+    *,
+    compute: str = "fp32",
+    variants=None,
+    repeats: int = 3,
+    warmup: int = 1,
+) -> StageAttribution:
+    """Block-granularity attribution for ``fuse="block"`` (megakernel)
+    rows: the same telescoped prefix-diff method as
+    :func:`attribute_stages`, but the prefixes are the two FUSED passes
+    (block1; block1+block2) — the only boundaries a megakernel row
+    honestly has. The result carries ``granularity="block"`` and a
+    method string naming the source, so downstream consumers (bench
+    rows, the regression gate) can never mistake it for a per-stage
+    split the fused pass did not measure.
+
+    ``variants``: the per-layer plan the row ran under (conv variant and
+    row_block govern the megakernel lowering; ``fuse`` itself is implied).
+    fp32/bf16 only, like :func:`attribute_stages`."""
+    import jax
+
+    from ..models.alexnet import BLOCKS12
+    from ..ops import megakernel as mk
+    from ..ops import pallas_kernels as pk
+    from ..ops.pallas_model import _layer_variants
+    from ..utils.timing import amortized_stats
+
+    cfg = cfg if cfg is not None else BLOCKS12
+    if compute == "bf16":
+        import jax.numpy as jnp
+
+        params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+        x = x.astype(jnp.bfloat16)
+    elif compute != "fp32":
+        raise ValueError(
+            f"block attribution supports fp32|bf16, got {compute!r} "
+            "(the int8w megakernel rides the quantized bench path)"
+        )
+    v = variants if variants is not None else pk.KernelVariants()
+    c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
+
+    def _block(cur, p, name, cspec, pspec, lrn):
+        lv = _layer_variants(v, name)
+        conv_v = lv.conv if lv.conv in ("taps", "vcol") else "vcol"
+        ho = (
+            cur.shape[1] + 2 * cspec.padding - cspec.filter_size
+        ) // cspec.stride + 1
+        return mk.conv_block_pallas(
+            cur, p[name]["w"], p[name]["b"],
+            stride=cspec.stride, padding=cspec.padding,
+            pool_window=pspec.window, pool_stride=pspec.stride,
+            lrn=lrn, variant=conv_v, row_block=max(lv.row_block, ho),
+        )
+
+    def _prefix(k: int):
+        def run(p, xin):
+            cur = _block(xin, p, "conv1", c1, p1, None)
+            if k >= 2:
+                cur = _block(cur, p, "conv2", c2, p2, n2)
+            return cur
+
+        return run
+
+    n_small = max(1, warmup)
+    prefix_ms: List[float] = []
+    with span(
+        "stages.attribute_blocks", compute=compute, batch=int(x.shape[0])
+    ):
+        for k in (1, 2):
+            jfn = jax.jit(_prefix(k))  # noqa: jit-in-loop
+            st = amortized_stats(
+                jfn, params, x,
+                n_small=n_small, n_large=n_small + max(1, repeats),
+            )
+            prefix_ms.append(st.per_call_ms)
+    stages: List[Tuple[str, float]] = []
+    prev = 0.0
+    for name, t in zip(("block1", "block2"), prefix_ms):
+        stages.append((name, max(0.0, t - prev)))
+        prev = t
+    clamped_sum = sum(ms for _n, ms in stages)
+    if clamped_sum > 0 and abs(clamped_sum - prefix_ms[-1]) > 1e-12:
+        scale = prefix_ms[-1] / clamped_sum
+        stages = [(name, ms * scale) for name, ms in stages]
+    return StageAttribution(
+        stages=tuple(stages),
+        prefix_ms=tuple(prefix_ms),
+        total_ms=prefix_ms[-1],
+        batch=int(x.shape[0]),
+        tier="pallas",
+        compute=compute,
+        granularity="block",
     )

@@ -190,8 +190,12 @@ def _warn_k_block_dropped(k_block: int, kk: int) -> None:
 # launch disappears). Bitwise-neutral (exact max on the casted value).
 # Applies where the geometry allows (taps/vcol, row_block >= ho); the
 # model builder falls back to the separate pool otherwise.
+# "block" goes all the way: the whole block (conv+ReLU+pool, +LRN when one
+# trails the pool) runs as ONE VMEM-resident pass (ops/megakernel.py) —
+# interior activations never touch HBM. Same geometry regime as hpool
+# (taps/vcol, sep2, whole image per program, no k_block).
 def _fuse_variant() -> str:
-    return env_variant("TPU_FRAMEWORK_FUSE", "none", ("none", "hpool"))
+    return env_variant("TPU_FRAMEWORK_FUSE", "none", ("none", "hpool", "block"))
 
 
 class KernelVariants(NamedTuple):

@@ -53,16 +53,33 @@ def _layer_variants(v, name: str) -> "pk.KernelVariants":
     return v.for_layer(name) if isinstance(v, pk.LayerVariants) else v
 
 
-def _conv_then_pool(x, w, b, cspec, pspec, v: "pk.KernelVariants"):
+def _conv_then_pool(x, w, b, cspec, pspec, v: "pk.KernelVariants", lrn=None):
     """conv(+relu) then max-pool, the ONE place that decides whether the
-    pool's H stage rides the conv epilogue (``fuse="hpool"``) — both
-    forward builders route conv->pool adjacencies through here, so the
-    geometry gate cannot drift between paths. Gate: taps/vcol lowering,
-    sep2 pool, whole image per program, and no K-blocking (the fused path
-    has no K grid dim — conv2d_pallas raises on that combination rather
-    than silently dropping a lever). Bitwise identical either way
-    (_conv_epilogue)."""
+    pool rides the conv pass — both forward builders route conv->pool
+    adjacencies through here, so the geometry gates cannot drift between
+    paths. ``fuse="hpool"`` fuses the pool's H stage into the conv
+    epilogue; ``fuse="block"`` goes further and runs the whole block
+    (conv+ReLU+pool, plus ``lrn`` when the caller passes the trailing
+    LrnSpec) as one VMEM-resident megakernel pass (ops/megakernel.py).
+    Both share the geometry regime: taps/vcol lowering, sep2 pool, whole
+    image per program, no K-blocking. hpool is bitwise identical either
+    way (_conv_epilogue); block is bitwise for fp32/bf16 (same
+    accumulation order, same cast points — tests/test_megakernel.py).
+    When ``lrn`` is given but the fused path is not taken, the trailing
+    LRN still runs here (staged), so callers hand off the whole block
+    either way."""
+    from . import megakernel as mk
+
     ho = (x.shape[1] + 2 * cspec.padding - cspec.filter_size) // cspec.stride + 1
+    if v.fuse == "block" and not mk.block_fusible_reason(
+        variant=v.conv, row_block=v.row_block, k_block=v.k_block,
+        pool=v.pool, out_h=ho, pool_window=pspec.window,
+    ):
+        return mk.conv_block_pallas(
+            x, w, b, stride=cspec.stride, padding=cspec.padding,
+            pool_window=pspec.window, pool_stride=pspec.stride,
+            lrn=lrn, variant=v.conv, row_block=v.row_block,
+        )
     if (
         v.fuse == "hpool"
         and v.conv in ("taps", "vcol")
@@ -75,12 +92,21 @@ def _conv_then_pool(x, w, b, cspec, pspec, v: "pk.KernelVariants"):
             variant=v.conv, row_block=v.row_block, k_block=0,
             hpool=(pspec.window, pspec.stride),
         )
-        return pk.maxpool_pallas_w(y, window=pspec.window, stride=pspec.stride)
-    y = pk.conv2d_pallas(
-        x, w, b, stride=cspec.stride, padding=cspec.padding, relu=True,
-        variant=v.conv, row_block=v.row_block, k_block=v.k_block,
-    )
-    return pk.maxpool_pallas(y, window=pspec.window, stride=pspec.stride, variant=v.pool)
+        out = pk.maxpool_pallas_w(y, window=pspec.window, stride=pspec.stride)
+    else:
+        y = pk.conv2d_pallas(
+            x, w, b, stride=cspec.stride, padding=cspec.padding, relu=True,
+            variant=v.conv, row_block=v.row_block, k_block=v.k_block,
+        )
+        out = pk.maxpool_pallas(
+            y, window=pspec.window, stride=pspec.stride, variant=v.pool
+        )
+    if lrn is not None:
+        out = pk.lrn_pallas(
+            out, size=lrn.size, alpha=lrn.alpha, beta=lrn.beta, k=lrn.k,
+            alpha_over_size=lrn.alpha_over_size,
+        )
+    return out
 
 
 def forward_blocks12_pallas(
@@ -107,10 +133,9 @@ def forward_blocks12_pallas(
         w1, b1 = _pad_axis(w1, 3, kp), _pad_axis(b1, 0, kp)
         w2 = _pad_axis(w2, 2, kp)  # conv2 contraction axis: zero rows
     x = _conv_then_pool(x, w1, b1, c1, p1, _layer_variants(v, "conv1"))
-    x = _conv_then_pool(x, w2, b2, c2, p2, _layer_variants(v, "conv2"))
-    x = pk.lrn_pallas(
-        x, size=n2.size, alpha=n2.alpha, beta=n2.beta, k=n2.k, alpha_over_size=n2.alpha_over_size
-    )
+    # Block 2's trailing LRN rides the conv->pool handoff so fuse="block"
+    # can fold it into the same pass; staged paths run it after the pool.
+    x = _conv_then_pool(x, w2, b2, c2, p2, _layer_variants(v, "conv2"), lrn=n2)
     return x
 
 
@@ -129,21 +154,27 @@ def forward_alexnet_pallas(
     cfg = cfg or ALEXNET
     v = variants if variants is not None else pk.KernelVariants.resolve()
     chain = list(cfg.layer_chain())
-    skip_pool_idx = -1
+    skip_idx: set = set()
     for idx, (name, spec) in enumerate(chain):
-        if idx == skip_pool_idx:
-            continue  # this pool was consumed by _conv_then_pool
+        if idx in skip_idx:
+            continue  # this pool/LRN was consumed by _conv_then_pool
         lv = _layer_variants(v, name)
         if isinstance(spec, ConvSpec):
             nxt = chain[idx + 1][1] if idx + 1 < len(chain) else None
             if isinstance(nxt, PoolSpec):
                 # conv->pool adjacency: the shared helper owns the
-                # fuse="hpool" decision (one gate for both builders); the
-                # conv's per-layer plan also governs the pool it feeds.
+                # fuse="hpool"/"block" decision (one gate for both
+                # builders); the conv's per-layer plan also governs the
+                # pool it feeds. A trailing LRN is part of the block.
+                nxt2 = chain[idx + 2][1] if idx + 2 < len(chain) else None
+                lrn = nxt2 if isinstance(nxt2, LrnSpec) else None
                 x = _conv_then_pool(
-                    x, params[name]["w"], params[name]["b"], spec, nxt, lv
+                    x, params[name]["w"], params[name]["b"], spec, nxt, lv,
+                    lrn=lrn,
                 )
-                skip_pool_idx = idx + 1
+                skip_idx.add(idx + 1)
+                if lrn is not None:
+                    skip_idx.add(idx + 2)
                 continue
             x = pk.conv2d_pallas(
                 x,

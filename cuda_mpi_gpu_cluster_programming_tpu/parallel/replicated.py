@@ -24,15 +24,29 @@ def build_replicated_forward(
     model_cfg: Blocks12Config = BLOCKS12,
     n_shards: int = 1,
     mesh: Optional[Mesh] = None,
+    quantized: bool = False,
 ) -> Callable:
+    """``quantized``: run the int8w policy on every replica — the quantized
+    forward (in-graph calibration from the fp32 tree,
+    precision.quantize.forward_blocks12_int8w) replaces the fp32 pass under
+    the same replicate-everything shardings, so each replica quantizes the
+    identical param tree to identical int8 values/scales."""
     mesh = mesh or make_mesh(n_shards)
     repl = NamedSharding(mesh, P())
+    if quantized:
+        from ..precision.quantize import forward_blocks12_int8w
+
+        model_fwd = lambda p, x: forward_blocks12_int8w(  # noqa: E731
+            p, x, model_cfg, tier="reference"
+        )
+    else:
+        model_fwd = lambda p, x: forward_blocks12(p, x, model_cfg)  # noqa: E731
 
     @jax.jit
     def fwd(params, x):
         params = jax.lax.with_sharding_constraint(params, repl)
         x = jax.lax.with_sharding_constraint(x, repl)
-        out = forward_blocks12(params, x, model_cfg)
+        out = model_fwd(params, x)
         return jax.lax.with_sharding_constraint(out, repl)
 
     return fwd

@@ -68,8 +68,24 @@ def _apply_spatial(
     s0 = i * lp.s0_coef + lp.s0_const
     win = lax.dynamic_slice_in_dim(padded, s0, lp.win_rows, axis=1)
     if lp.kind == "conv":
-        w, b = params[lp.name]["w"], params[lp.name]["b"]
-        out = conv_fn(win, w, b, stride=spec.stride, padding_w=spec.padding)
+        p = params[lp.name]
+        if "scale" in p:
+            # int8w conv on this shard's rows: dequant-free (int8-valued
+            # weights cast to bf16, exact), fp32 rescale + bias between
+            # the conv and the mask. Ordering invariant: rescale and bias
+            # land BEFORE the row mask (mask zeroes non-owned rows and
+            # relu(0)=0 keeps them zero — bias after the mask would
+            # resurrect them), mirroring the fp32 path where conv_fn adds
+            # the bias itself.
+            zb = jnp.zeros(p["b"].shape, jnp.bfloat16)
+            out = conv_fn(
+                win.astype(jnp.bfloat16), p["w"].astype(jnp.bfloat16), zb,
+                stride=spec.stride, padding_w=spec.padding,
+            ).astype(jnp.float32)
+            out = out * p["scale"] + p["b"].astype(jnp.float32)
+        else:
+            w, b = p["w"], p["b"]
+            out = conv_fn(win, w, b, stride=spec.stride, padding_w=spec.padding)
     else:
         out = pool_fn(win, window=spec.window, stride=spec.stride)
     # out has exactly b_out rows: (win_rows - F)//S + 1 == b_out
@@ -102,6 +118,7 @@ def build_sharded_forward(
     staged: bool = False,
     with_digests: bool = False,
     plan=None,
+    quantized: bool = False,
 ) -> Callable:
     """Jitted ``(params, x) -> out`` running row-sharded over ``n_shards``.
 
@@ -123,6 +140,16 @@ def build_sharded_forward(
     does not apply on this path (the hvalid lowering has no fused epilogue
     to hang an hpool stage off) and is ignored; reference tier ignores the
     whole plan, as everywhere else.
+
+    ``quantized``: run the int8w policy sharded. Conv params quantize
+    IN-GRAPH from the fp32 tree (calibration == the seeded init stream, the
+    same contract as ``precision.quantize.forward_blocks12_int8w``), so the
+    returned function keeps the ``(params, x) -> out`` shape; the int8
+    values and their per-channel scales replicate to every shard with the
+    rest of the param tree, each shard rescales its own rows before the
+    ownership mask, activations ride bf16 between stages, and LRN/final
+    output compute in fp32 — shard-count-invariant and screened per rung by
+    ``precision.gate.ToleranceGate.screen_sharded``.
     """
     mesh = mesh or make_mesh(n_shards, axis_name=AXIS)
     n = n_shards
@@ -180,8 +207,10 @@ def build_sharded_forward(
         for lp in splan.layers:
             spec = specs[lp.name]
             if lp.kind == "pointwise":
+                # int8w contract: LRN computes in fp32 (squares + pow need
+                # the headroom) — same as forward_blocks12_int8w.
                 cur = ops.lrn(
-                    cur,
+                    cur.astype(jnp.float32) if quantized else cur,
                     size=spec.size,
                     alpha=spec.alpha,
                     beta=spec.beta,
@@ -197,7 +226,11 @@ def build_sharded_forward(
                 cur = _apply_spatial(
                     lp, cur, params, spec, AXIS, n, conv_fn, pool_fn, staged
                 )
-                cur = ops.relu(cur) if lp.kind == "conv" else cur
+                if lp.kind == "conv":
+                    cur = ops.relu(cur)
+                    if quantized:
+                        # activations ride bf16 between quantized stages
+                        cur = cur.astype(jnp.bfloat16)
             if with_digests:
                 # In-graph sentinel tap: one float32 digest of this shard's
                 # block at the layer boundary. Shard-varying (each shard
@@ -227,6 +260,18 @@ def build_sharded_forward(
 
     @jax.jit
     def fwd(params, x):
+        if quantized:
+            from ..precision.quantize import quantize_conv_params
+
+            # In-graph quantization keeps the (fp32_params, x) -> out shape
+            # every builder expects; "w" carries the int8 values so the
+            # shard body's param access pattern is unchanged, "scale"
+            # marks the entry quantized.
+            params = {
+                name: {"w": e["q"], "scale": e["scale"], "b": e["b"]}
+                for name, e in quantize_conv_params(params).items()
+            }
+            x = x.astype(jnp.bfloat16)
         pad = h_pad - x.shape[1]
         if pad:
             x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))

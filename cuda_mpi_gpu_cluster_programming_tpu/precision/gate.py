@@ -48,7 +48,18 @@ class StageBudget:
 
 
 DEFAULT_BUDGETS: Dict[str, Dict[str, StageBudget]] = {
-    "fp32": {"*": StageBudget(max_abs=1e-4, max_rel=1e-5)},
+    "fp32": {
+        "*": StageBudget(max_abs=1e-4, max_rel=1e-5),
+        # Block-granularity rows (the megakernel screen): the "*" budget is
+        # calibrated for the oracle comparing against ITSELF through the
+        # same staged ops; the fused fp32 kernel is a different lowering
+        # whose fp32 MACs accumulate in a different order (~1 ulp per step,
+        # observed 1.3e-4 abs through conv1's 363-term dots at the full 227
+        # geometry) — still ~7x headroom below these, so a broken fusion
+        # (not reassociation) is what trips them.
+        "block1": StageBudget(max_abs=1e-3, max_rel=1e-4),
+        "block2": StageBudget(max_abs=1e-3, max_rel=1e-4),
+    },
     "bf16": {"*": StageBudget(max_rel=2e-2)},
     "int8w": {"*": StageBudget(max_rel=6e-2)},
 }
@@ -196,6 +207,68 @@ def staged_policy_outputs(params, x, cfg=BLOCKS12, policy="fp32") -> Dict[str, n
     return stages
 
 
+# The fused megakernel's comparison surface: each block's single output,
+# joined to the staged oracle at the block BOUNDARY stages (a fused block
+# has no interior taps to compare — block granularity is the honest one).
+BLOCK_BOUNDARIES = (("block1", "pool1"), ("block2", "lrn2"))
+
+
+def megakernel_block_outputs(
+    params, x, cfg=BLOCKS12, policy="fp32", variants=None
+) -> Dict[str, np.ndarray]:
+    """fp32 copies of the fused megakernel's block outputs under ``policy``
+    — the candidate side of :meth:`ToleranceGate.screen_blocks`. Runs both
+    blocks through ``ops.megakernel`` (whole image per program, the only
+    regime block fusion has), int8w via the dequant-free epilogue-rescale
+    variant."""
+    import jax.numpy as jnp
+
+    from ..ops import megakernel as mk
+    from ..ops import pallas_kernels as pk
+
+    pol = resolve_policy(policy)
+    c1, p1, c2, p2, n2 = cfg.conv1, cfg.pool1, cfg.conv2, cfg.pool2, cfg.lrn2
+    v = variants if variants is not None else pk.KernelVariants()
+    conv_v = v.conv if v.conv in ("taps", "vcol") else "vcol"
+    out: Dict[str, np.ndarray] = {}
+    blocks = (("block1", "conv1", c1, p1, None), ("block2", "conv2", c2, p2, n2))
+    if pol.quantized:
+        from .quantize import quantize_conv_params
+
+        qp = quantize_conv_params(params)
+        cur = x.astype(jnp.bfloat16)
+        for bname, cname, cspec, pspec, lrn in blocks:
+            ho = (
+                cur.shape[1] + 2 * cspec.padding - cspec.filter_size
+            ) // cspec.stride + 1
+            e = qp[cname]
+            cur = mk.int8w_conv_block_pallas(
+                cur, e["q"], e["scale"], e["b"],
+                stride=cspec.stride, padding=cspec.padding,
+                pool_window=pspec.window, pool_stride=pspec.stride,
+                lrn=lrn, variant=conv_v, row_block=max(v.row_block, ho),
+            )
+            out[bname] = np.asarray(cur, np.float32)
+        return out
+    cur = x
+    for bname, cname, cspec, pspec, lrn in blocks:
+        lp = pol.layer(cname)
+        cdt = jdt(lp.compute)
+        ho = (
+            cur.shape[1] + 2 * cspec.padding - cspec.filter_size
+        ) // cspec.stride + 1
+        cur = mk.conv_block_pallas(
+            cur.astype(cdt),
+            params[cname]["w"].astype(jdt(lp.params)),
+            params[cname]["b"].astype(cdt),
+            stride=cspec.stride, padding=cspec.padding,
+            pool_window=pspec.window, pool_stride=pspec.stride,
+            lrn=lrn, variant=conv_v, row_block=max(v.row_block, ho),
+        )
+        out[bname] = np.asarray(cur, np.float32)
+    return out
+
+
 class ToleranceGate:
     """Screen a candidate policy against the fp32 oracle, stage by stage.
 
@@ -268,6 +341,119 @@ class ToleranceGate:
             b = self.budget_for(pol.name, stage)
             res.stages.append(StageCheck(stage, diff, rel, b.max_abs, b.max_rel))
         self._journal(res, key)
+        return res
+
+    def screen_blocks(
+        self,
+        policy,
+        params,
+        x,
+        model_cfg=BLOCKS12,
+        *,
+        variants=None,
+        key: str = "",
+    ) -> GateResult:
+        """Screen the fused megakernel at BLOCK granularity: each block's
+        single output vs the fp32 staged oracle at the block-boundary
+        stages (``BLOCK_BOUNDARIES``). This is the screen that guards the
+        ``fuse="block"`` candidates — a fused block has no interior taps,
+        so per-stage comparison would be fake; the honest surface is the
+        block output, and the budgets are the boundary stage's (falling
+        back to the policy's "*" row). Journals ``gate_pass``/``gate_fail``
+        like :meth:`screen`."""
+        pol: DtypePolicy = resolve_policy(policy)
+        res = GateResult(policy=pol.name)
+        if self.preflight:
+            from ..resilience.sentinel import oracle_spot_check
+
+            err = oracle_spot_check()
+            if err is not None and err > 1e-3:
+                res.oracle_fault = (
+                    f"fp32 oracle failed preflight: device fp32 conv deviates "
+                    f"from the tests/oracle.py loop oracle by {err:.3e}"
+                )
+                self._journal(res, key)
+                return res
+        oracle = staged_policy_outputs(params, x, model_cfg, "fp32")
+        try:
+            cand = megakernel_block_outputs(
+                params, x, model_cfg, pol, variants=variants
+            )
+        except Exception as e:  # noqa — an unlowerable megakernel must fail, not wedge
+            b = self.budget_for(pol.name, "block1")
+            res.stages.append(
+                StageCheck(
+                    f"megakernel-error:{type(e).__name__}",
+                    math.inf, math.inf, b.max_abs, b.max_rel,
+                )
+            )
+            self._journal(res, key)
+            return res
+        for bname, boundary in BLOCK_BOUNDARIES:
+            want, got = oracle[boundary], cand[bname]
+            diff = float(np.max(np.abs(got - want))) if want.size else 0.0
+            denom = float(np.max(np.abs(want))) if want.size else 0.0
+            rel = diff / denom if denom > 0 else (0.0 if diff == 0.0 else math.inf)
+            b = self.budget_for(pol.name, bname)
+            res.stages.append(StageCheck(bname, diff, rel, b.max_abs, b.max_rel))
+        self._journal(res, key)
+        return res
+
+    def screen_sharded(
+        self,
+        policy,
+        params,
+        x,
+        model_cfg=BLOCKS12,
+        *,
+        n_shards: int,
+        tier: str = "reference",
+        staged: bool = False,
+        key: str = "",
+    ) -> GateResult:
+        """Per-rung screen for the sharded tier: the full sharded forward's
+        FINAL output under ``policy`` (int8w runs the quantized sharded
+        path) vs the fp32 staged oracle's lrn2 boundary. Shard count is
+        part of the journaled key — the halo/mask machinery must hold the
+        budget at EVERY rung, not just n=1."""
+        import jax.numpy as jnp
+
+        from ..parallel.sharded import build_sharded_forward
+
+        pol: DtypePolicy = resolve_policy(policy)
+        res = GateResult(policy=pol.name)
+        if self.preflight:
+            from ..resilience.sentinel import oracle_spot_check
+
+            err = oracle_spot_check()
+            if err is not None and err > 1e-3:
+                res.oracle_fault = (
+                    f"fp32 oracle failed preflight: device fp32 conv deviates "
+                    f"from the tests/oracle.py loop oracle by {err:.3e}"
+                )
+                self._journal(res, key or f"gate-sharded:{pol.name}|n{n_shards}")
+                return res
+        want = staged_policy_outputs(params, x, model_cfg, "fp32")["lrn2"]
+        fwd = build_sharded_forward(
+            model_cfg, n_shards, tier=tier, staged=staged,
+            quantized=pol.quantized,
+        )
+        if pol.quantized or pol.name == "fp32":
+            got = np.asarray(fwd(params, x), np.float32)
+        else:
+            # bf16 rung: the same cast wrapper configs.build_forward ships.
+            pb = {
+                name: {k2: a.astype(jnp.bfloat16) for k2, a in p.items()}
+                for name, p in params.items()
+            }
+            got = np.asarray(fwd(pb, x.astype(jnp.bfloat16)), np.float32)
+        stage = f"lrn2@n{n_shards}"
+        diff = float(np.max(np.abs(got - want))) if want.size else 0.0
+        denom = float(np.max(np.abs(want))) if want.size else 0.0
+        rel = diff / denom if denom > 0 else (0.0 if diff == 0.0 else math.inf)
+        b = self.budget_for(pol.name, "lrn2")
+        res.stages.append(StageCheck(stage, diff, rel, b.max_abs, b.max_rel))
+        self._journal(res, key or f"gate-sharded:{pol.name}|n{n_shards}")
         return res
 
     def _journal(self, res: GateResult, key: str) -> None:

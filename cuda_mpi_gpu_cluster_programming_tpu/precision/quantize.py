@@ -119,11 +119,31 @@ def int8w_conv(
     return y.astype(jnp.bfloat16)
 
 
-def int8w_conv_then_pool(x, q, scale, b, cspec, pspec, v=None, *, tier="pallas"):
+def int8w_conv_then_pool(x, q, scale, b, cspec, pspec, v=None, *, tier="pallas", lrn=None):
     """The int8w lowering unit the dtype sweep times — the quantized
     counterpart of ``ops.pallas_model._conv_then_pool`` (conv + rescale +
     bias + ReLU, then the trailing max pool under the same per-layer
-    variant plan)."""
+    variant plan). ``v.fuse == "block"`` routes the whole block through the
+    dequant-free megakernel (``ops.megakernel.int8w_conv_block_pallas``)
+    where the geometry gate allows: per-channel rescale in the epilogue on
+    the UNCAST fp32 accumulator — which the staged chain cannot do (its
+    conv kernel writes bf16 before the host rescale), so megakernel int8w
+    parity is tolerance-gated, not bitwise. ``lrn`` (a LrnSpec) folds the
+    block's trailing LRN in either way — fused in-kernel, staged via the
+    fp32 reference LRN (the same op ``forward_blocks12_int8w`` uses)."""
+    ho = (x.shape[1] + 2 * cspec.padding - cspec.filter_size) // cspec.stride + 1
+    if tier == "pallas" and v is not None and v.fuse == "block":
+        from ..ops import megakernel as mk
+
+        if not mk.block_fusible_reason(
+            variant=v.conv, row_block=v.row_block, k_block=v.k_block,
+            pool=v.pool, out_h=ho, pool_window=pspec.window,
+        ):
+            return mk.int8w_conv_block_pallas(
+                x, q, scale, b, stride=cspec.stride, padding=cspec.padding,
+                pool_window=pspec.window, pool_stride=pspec.stride,
+                lrn=lrn, variant=v.conv, row_block=v.row_block,
+            )
     y = int8w_conv(
         x, q, scale, b, stride=cspec.stride, padding=cspec.padding,
         relu=True, tier=tier, variants=v,
@@ -132,12 +152,22 @@ def int8w_conv_then_pool(x, q, scale, b, cspec, pspec, v=None, *, tier="pallas")
         from ..ops import pallas_kernels as pk
 
         pool_variant = v.pool if v is not None else None
-        return pk.maxpool_pallas(
+        out = pk.maxpool_pallas(
             y, window=pspec.window, stride=pspec.stride, variant=pool_variant
         )
-    from ..ops import reference as ops
+    else:
+        from ..ops import reference as ops
 
-    return ops.maxpool(y, window=pspec.window, stride=pspec.stride)
+        out = ops.maxpool(y, window=pspec.window, stride=pspec.stride)
+    if lrn is not None:
+        from ..ops import reference as ops
+
+        out = ops.lrn(
+            out.astype(jnp.float32),
+            size=lrn.size, alpha=lrn.alpha, beta=lrn.beta, k=lrn.k,
+            alpha_over_size=lrn.alpha_over_size,
+        )
+    return out
 
 
 def forward_blocks12_int8w(
@@ -171,6 +201,26 @@ def forward_blocks12_int8w(
     def tap(name, arr):
         if taps:
             stages[name] = arr.astype(jnp.float32)
+
+    if tier == "pallas" and not taps and any(
+        _layer_variants(v, n).fuse == "block" for n in ("conv1", "conv2")
+    ):
+        # Megakernel route: each block is one VMEM-resident pass (the
+        # trailing LRN folds into block 2). Taps callers (the gate's
+        # staged-oracle surface) stay on the staged chain below — a fused
+        # block has no interior boundaries to tap; the gate screens fused
+        # outputs at BLOCK granularity instead (precision.gate
+        # ``screen_blocks``).
+        cur = x.astype(jnp.bfloat16)
+        e1, e2 = qp["conv1"], qp["conv2"]
+        cur = int8w_conv_then_pool(
+            cur, e1["q"], e1["scale"], e1["b"], c1, p1,
+            _layer_variants(v, "conv1"), tier=tier,
+        )
+        return int8w_conv_then_pool(
+            cur, e2["q"], e2["scale"], e2["b"], c2, p2,
+            _layer_variants(v, "conv2"), tier=tier, lrn=n2,
+        )
 
     cur = x.astype(jnp.bfloat16)
     for cname, cspec, pname, pspec in (

@@ -320,6 +320,10 @@ _HOT_LOOP_FILES = {
     # as the front end, plus the fleet launcher whose READY scan gates
     # drill bring-up.
     "router.py", "fleet.py",
+    # The fused-block megakernels (ISSUE 17): the whole point is one
+    # HBM round trip per block, so a stray host sync in the wrapper
+    # would sit directly inside every timed fused pass.
+    "megakernel.py",
 }
 _HOT_LOOP_DIRS = {"observability"}
 

@@ -57,13 +57,17 @@ def _default_timer(
     import jax
     import jax.numpy as jnp
 
-    from ..models.alexnet import ConvSpec, PoolSpec
+    from ..models.alexnet import ConvSpec, LrnSpec, PoolSpec
     from ..ops import pallas_kernels as pk
     from ..ops.pallas_model import _conv_then_pool
     from ..utils.timing import amortized_stats
 
     cspec = ConvSpec(g.out_channels, g.filter_size, g.stride, g.padding)
     pspec = PoolSpec(g.pool_window, g.pool_stride) if g.has_pool else None
+    # The block's trailing LRN (when the model has one) is timed for EVERY
+    # candidate — fused candidates fold it in-kernel, staged candidates run
+    # it as the trailing launch — so fused-vs-staged compare equal work.
+    lrn = LrnSpec(*g.lrn) if g.lrn else None
     n_small = max(1, warmup)
     if dtype == "int8w":
         # The quantized lowering unit: bf16 activations, int8-valued bf16
@@ -81,7 +85,7 @@ def _default_timer(
         if pspec is not None:
             fn = jax.jit(
                 lambda x, q, s, b: int8w_conv_then_pool(
-                    x, q, s, b, cspec, pspec, v, tier="pallas"
+                    x, q, s, b, cspec, pspec, v, tier="pallas", lrn=lrn
                 )
             )
         else:
@@ -104,7 +108,9 @@ def _default_timer(
     )
     b = jnp.zeros((g.out_channels,), jdt)
     if g.has_pool:
-        fn = jax.jit(lambda x, w, b: _conv_then_pool(x, w, b, cspec, pspec, v))
+        fn = jax.jit(
+            lambda x, w, b: _conv_then_pool(x, w, b, cspec, pspec, v, lrn=lrn)
+        )
     else:
         fn = jax.jit(
             functools.partial(
@@ -137,8 +143,16 @@ def tune_layer(
     timer: Timer,
     log: Callable[[str], None],
     interpret: Optional[bool] = None,
+    block_screen: str = "",
 ) -> Tuple[KernelVariants, dict, str]:
-    """Sweep one layer; returns (winner, stats, degraded_reason)."""
+    """Sweep one layer; returns (winner, stats, degraded_reason).
+
+    ``block_screen``: a non-empty string prunes every ``fuse="block"``
+    candidate with that reason BEFORE timing — the dtype sweep passes the
+    ToleranceGate's block-screen failure here, so a megakernel that fails
+    its fp32-oracle screen never spends timing budget and its fate is
+    attributable in the plan record (``pruned_reasons``), exactly like a
+    geometry prune."""
     interpret = _interpret_mode() if interpret is None else interpret
     default = KernelVariants().bind(g.out_channels)
     pruned: list = []
@@ -146,6 +160,14 @@ def tune_layer(
         g, interpret=interpret, dtype=dtype,
         on_prune=lambda v, why: pruned.append(why),
     )
+    if block_screen:
+        kept = []
+        for v in cands:
+            if v.fuse == "block":
+                pruned.append(block_screen)
+            else:
+                kept.append(v)
+        cands = kept
     ch = chaos.active()
     timed: list = []   # (ms, ci95, n, variants)
     failed: list = []  # (variants, cause)
@@ -175,10 +197,17 @@ def tune_layer(
             cause = f"{type(e).__name__}: {e}"[:120]
             failed.append((v, cause))
             log(f"tune {g.name}: {v.label()} FAILED ({cause})")
+    # Attributable prunes in the persisted record: reason -> count, so a
+    # plan says WHY every dropped candidate (geometry, dtype policy, or a
+    # gate-failed megakernel) is absent — not just how many.
+    reasons: dict = {}
+    for why in pruned:
+        reasons[why] = reasons.get(why, 0) + 1
     stats = {
         "geometry": g.describe(),
         "candidates": len(cands),
         "pruned": len(pruned),
+        "pruned_reasons": reasons,
         "timed": len(timed),
         "failed": len(failed),
     }
@@ -223,6 +252,7 @@ def autotune_model(
     timer: Optional[Timer] = None,
     log: Callable[[str], None] = print,
     device_kind: Optional[str] = None,
+    block_screen: str = "",
 ) -> TunePlan:
     """Sweep every conv layer of ``model_cfg`` and return the TunePlan."""
     deadline = deadline or Deadline.after(None)
@@ -249,6 +279,7 @@ def autotune_model(
             winner, lstats, degraded = tune_layer(
                 g, dtype=dtype, batch=batch, deadline=deadline,
                 repeats=repeats, warmup=warmup, timer=timer, log=log,
+                block_screen=block_screen,
             )
         layers.append((name, winner))
         stats[name] = lstats
@@ -279,6 +310,7 @@ def autotune(
     timer: Optional[Timer] = None,
     log: Callable[[str], None] = print,
     device_kind: Optional[str] = None,
+    block_screen: str = "",
 ) -> Tuple[TunePlan, bool]:
     """Cached sweep: a fresh on-disk plan for this exact point (same device,
     geometry, batch, dtype, code revision) short-circuits the whole sweep —
@@ -297,7 +329,7 @@ def autotune(
     plan = autotune_model(
         model_cfg, dtype=dtype, batch=batch, deadline=deadline,
         repeats=repeats, warmup=warmup, timer=timer, log=log,
-        device_kind=device_kind,
+        device_kind=device_kind, block_screen=block_screen,
     )
     save_plan(plan, path)
     return plan, False
@@ -468,10 +500,33 @@ def autotune_precision(
             f"tune dtype {dt}: gate pass (margin {res.margin:.3f}, "
             f"worst stage {res.worst_stage or '-'})"
         )
+        # Second screen, block granularity: the megakernel's fused block
+        # outputs vs the fp32 oracle's block boundaries. A failure prunes
+        # ONLY the fuse="block" candidates for this dtype (journaled,
+        # reason lands in the plan's pruned_reasons) — the staged chain
+        # already passed its per-stage screen above. Injectable gates
+        # without the method (test stubs) skip the screen.
+        block_screen = ""
+        if hasattr(gate, "screen_blocks"):
+            with obs_span("tune.gate_blocks", dtype=dt):
+                bres = gate.screen_blocks(
+                    dt, params, x, model_cfg,
+                    key=f"gate-blocks:{dt}|{device_kind}|{sk}|b{batch}",
+                )
+            if not bres.passed:
+                block_screen = (
+                    f"fuse=block gate-pruned for {dt}: {bres.reason()}"
+                )
+                log(f"tune dtype {dt}: megakernel {block_screen}")
+            else:
+                log(
+                    f"tune dtype {dt}: megakernel block gate pass "
+                    f"(margin {bres.margin:.3f})"
+                )
         plan, was_cached = autotune(
             path, model_cfg, dtype=dt, batch=batch, force=force,
             deadline=deadline, repeats=repeats, warmup=warmup, timer=timer,
-            log=log, device_kind=device_kind,
+            log=log, device_kind=device_kind, block_screen=block_screen,
         )
         plans[dt] = plan
         inner_cached.append(was_cached)

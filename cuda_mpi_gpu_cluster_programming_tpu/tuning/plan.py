@@ -63,6 +63,7 @@ VARIANT_ENV = {
 _REV_FILES = (
     "../ops/pallas_kernels.py",
     "../ops/pallas_model.py",
+    "../ops/megakernel.py",
     "space.py",
     "../precision/quantize.py",
 )

@@ -20,6 +20,7 @@ import dataclasses
 import itertools
 from typing import Callable, List, Optional, Tuple
 
+from ..ops.megakernel import block_fusible_reason
 from ..ops.pallas_kernels import KernelVariants
 
 # Knob domains — mirror the env_variant allowed-sets in ops.pallas_kernels
@@ -28,7 +29,7 @@ CONV_VARIANTS = ("taps", "pairs", "fused", "vcol", "g8")
 POOL_VARIANTS = ("sep2", "phases")
 ROW_BLOCKS = (8, 16, 32, 64)
 K_BLOCKS = (0, 64, 128)
-FUSES = ("none", "hpool")
+FUSES = ("none", "hpool", "block")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +46,12 @@ class ConvGeometry:
     in_w: int
     pool_window: int = 0  # 0 = no adjacent pool
     pool_stride: int = 0
+    # The LRN trailing the pool, when the model has one: (size, alpha,
+    # beta, k, alpha_over_size) as a hashable tuple, () = none. Part of
+    # the tuning unit because fuse="block" folds it into the megakernel
+    # pass — and the timer must include it for STAGED candidates too, or
+    # fused-vs-staged timings would compare unequal work.
+    lrn: tuple = ()
 
     @property
     def out_h(self) -> int:
@@ -71,7 +78,7 @@ def conv_geometries(model_cfg) -> List[ConvGeometry]:
     """The model's conv layers with their input dims and trailing pools —
     driven by the shared ``models.alexnet.layer_dims`` traversal, so tuned
     geometry cannot drift from the FLOP/shape accounting."""
-    from ..models.alexnet import ConvSpec, PoolSpec, layer_dims
+    from ..models.alexnet import ConvSpec, LrnSpec, PoolSpec, layer_dims
 
     chain = list(layer_dims(model_cfg))
     out: List[ConvGeometry] = []
@@ -79,9 +86,15 @@ def conv_geometries(model_cfg) -> List[ConvGeometry]:
         if not isinstance(spec, ConvSpec):
             continue
         pw = ps = 0
+        lrn: tuple = ()
         if i + 1 < len(chain) and isinstance(chain[i + 1][1], PoolSpec):
             nxt = chain[i + 1][1]
             pw, ps = nxt.window, nxt.stride
+            if i + 2 < len(chain) and isinstance(chain[i + 2][1], LrnSpec):
+                n = chain[i + 2][1]
+                lrn = (
+                    n.size, n.alpha, n.beta, n.k, n.alpha_over_size,
+                )
         out.append(
             ConvGeometry(
                 name=name,
@@ -94,6 +107,7 @@ def conv_geometries(model_cfg) -> List[ConvGeometry]:
                 in_w=wi,
                 pool_window=pw,
                 pool_stride=ps,
+                lrn=lrn,
             )
         )
     return out
@@ -108,7 +122,10 @@ def prune_reason(
     policy: int8w runs the conv with the fused bias/ReLU epilogue disabled
     (the per-channel rescale lands between accumulation and bias —
     precision.quantize), so epilogue fusion is not a legal candidate
-    there."""
+    there. fuse="block" IS legal under int8w: the megakernel applies the
+    per-channel rescale in its own epilogue, between the fp32
+    accumulation and the bias (ops.megakernel) — the staged-chain
+    limitation that rules hpool out does not apply."""
     if v.fuse == "hpool" and dtype == "int8w":
         return (
             "hpool fusion needs the in-kernel bias/ReLU epilogue; int8w "
@@ -142,6 +159,16 @@ def prune_reason(
             )
         if v.k_block:
             return "hpool fusion does not compose with k_block"
+    if v.fuse == "block":
+        # One gate for builder, wrapper, and sweep: ops.megakernel owns
+        # the block-fusion geometry rules, so a candidate this accepts is
+        # exactly one ops.pallas_model._conv_then_pool would fuse.
+        why = block_fusible_reason(
+            variant=v.conv, row_block=v.row_block, k_block=v.k_block,
+            pool=v.pool, out_h=g.out_h, pool_window=g.pool_window,
+        )
+        if why:
+            return why
     return ""
 
 

@@ -543,6 +543,60 @@ else
     say "hpool FAILED to lower or mismatched on chip — see $LOG; A/B skipped (fuse=none default stands)"
 fi
 
+say "fused-block megakernels (ISSUE 17): first-ever Mosaic lowering + ToleranceGate screen_blocks on chip across fp32/bf16/int8w (the in-register swapaxes is the acknowledged lowering risk — probe before any timing)"
+if timeout 900 python - >>"$LOG" 2>&1 <<'EOF'
+import jax
+from cuda_mpi_gpu_cluster_programming_tpu.models.init import (
+    deterministic_input, init_params_deterministic)
+from cuda_mpi_gpu_cluster_programming_tpu.precision.gate import ToleranceGate
+params = init_params_deterministic()
+x = deterministic_input(batch=4)
+gate = ToleranceGate()
+plat = jax.devices()[0].platform
+ok = True
+for dt in ("fp32", "bf16", "int8w"):
+    res = gate.screen_blocks(dt, params, x, key=f"gate-blocks:{dt}|onheal|{plat}")
+    print(dt, "megakernel screen_blocks on", plat, "passed:", res.passed,
+          "margin:", round(res.margin(), 4) if res.passed else res.reason())
+    ok = ok and res.passed
+assert ok
+print("megakernel lowering+gate OK on", plat)
+EOF
+then
+    echo "megakernel on-chip gate OK" | tee -a "$LOG"
+    # fuse=block vs fuse=none A/B at the headline point, resolved-variant
+    # prefixes (same policy as g8/hpool): the autotuner only adopts the
+    # megakernel when measured faster — these rows are that measurement's
+    # independent echo.
+    for comp in bf16 fp32; do
+        for fuse in none block; do
+            FUSE_PREFIX=$(TPU_FRAMEWORK_FUSE=$fuse python -c "
+from cuda_mpi_gpu_cluster_programming_tpu.ops.pallas_kernels import KernelVariants
+v = KernelVariants.resolve()
+print(f'fuse={v.fuse} conv={v.conv} rb={v.row_block} kb={v.k_block}')")
+            TPU_FRAMEWORK_FUSE=$fuse timeout 600 \
+                python -m cuda_mpi_gpu_cluster_programming_tpu.run \
+                --config v3_pallas --batch 128 --compute $comp --repeats 100 2>&1 \
+                | grep "completed in" \
+                | sed "s/^/$FUSE_PREFIX $comp /" | tee -a "$LOG"
+        done
+    done
+    # First BENCH_DTYPE rows under the megakernel: block-granularity
+    # breakdown + roofline sub-objects (measured block MFU vs
+    # fused_mfu_ceiling) land in the perf artifact, machine-comparable
+    # across BENCH_r* captures.
+    for dt in bf16 int8w; do
+        TPU_FRAMEWORK_FUSE=block TPU_FRAMEWORK_ROWBLOCK=64 BENCH_DTYPE=$dt \
+        BENCH_CONFIG=v3_pallas BENCH_BF16=0 \
+            timeout 1200 python bench.py 2>>"$LOG" \
+            | grep '^{' >> perf/bench_megakernel_${FTS}.jsonl \
+            || say "megakernel $dt bench row failed — see $LOG"
+    done
+    [ -s perf/bench_megakernel_${FTS}.jsonl ] && tee -a "$LOG" < perf/bench_megakernel_${FTS}.jsonl
+else
+    say "megakernel FAILED to lower or gate on chip — see $LOG; A/B + BENCH_DTYPE fused rows skipped (staged chain stands, candidates stay gate-pruned)"
+fi
+
 say "conv variant A/B on the real chip: taps/pairs x rowblock 8/16/32 x kblock 0/128 (already measured 2026-07-31 — re-confirmation rows; runs AFTER the never-measured g8/hpool A/Bs)"
 # Runs BEFORE the attention A/B since the 01:37Z re-wedge: this is the
 # adoption-gating measurement (v3_pallas bf16 >= 0.5x v1_jit at b=128,

@@ -221,11 +221,13 @@ def test_build_forward_policy_matches_oracle_within_budget(seeded):
         assert rel <= DEFAULT_BUDGETS[pol]["*"].max_rel, (pol, rel)
 
 
-def test_build_forward_rejects_quantized_sharded():
-    """int8w is a single-device Blocks 1-2 policy for now; sharded configs
-    must refuse it loudly, not silently run unquantized."""
-    with pytest.raises(ValueError, match="single-device"):
-        build_forward(REGISTRY["v2.2_sharded"], SMALL, n_shards=2, policy="int8w")
+def test_build_forward_rejects_quantized_unsupported_configs():
+    """ISSUE 17 lifted the halo/replicated int8w refusal, but the
+    still-unsupported combos (tensor-parallel, full AlexNet) must keep
+    refusing loudly and attributably, not silently run unquantized."""
+    for key in ("v7_tp", "v6_full_sharded"):
+        with pytest.raises(ValueError, match="open ROADMAP items"):
+            build_forward(REGISTRY[key], SMALL, n_shards=2, policy="int8w")
     with pytest.raises(ValueError, match="unknown compute mode"):
         build_forward(REGISTRY["v1_jit"], SMALL, policy="int9")
 
@@ -320,7 +322,10 @@ def test_autotune_precision_all_pruned_raises(seeded, tmp_path):
 
 def test_int8w_candidate_space_excludes_epilogue_fusion():
     """hpool fusion needs the in-kernel bias/ReLU epilogue; int8w's rescale
-    lands between accumulation and bias, so the sweep must not offer it."""
+    lands between accumulation and bias, so the sweep must not offer it.
+    Block fusion (the ISSUE 17 megakernel) IS legal under int8w — its
+    epilogue rescales the fp32 accumulator before bias by construction —
+    so "block" stays in the quantized space."""
     from cuda_mpi_gpu_cluster_programming_tpu.tuning import space as ts
 
     for g in ts.conv_geometries(SMALL):
@@ -330,7 +335,9 @@ def test_int8w_candidate_space_excludes_epilogue_fusion():
             for v in ts.candidate_space(g, interpret=True, dtype="int8w")
         }
         assert "hpool" in fp32_fuses
-        assert int8_fuses == {"none"}
+        assert "block" in fp32_fuses
+        assert "hpool" not in int8_fuses
+        assert int8_fuses == {"none", "block"}
 
 
 # ------------------------------------------------------------- threading ---
