@@ -113,6 +113,17 @@ def make_parser() -> argparse.ArgumentParser:
         "(0.5 = twice as tight)",
     )
     rl.add_argument(
+        "--controller",
+        choices=("on", "off"),
+        default="",
+        help="autopilot A/B dial (docs/SERVING.md \"Autopilot\"): 'on' "
+        "forces the closed-loop controller onto the replay server, "
+        "'off' strips a recorded one; default re-drives as recorded. "
+        "Replaying one saturating trace both ways is the controller's "
+        "win-quantification: interactive burn lower with it on, books "
+        "closed both ways",
+    )
+    rl.add_argument(
         "--journal-out",
         default="",
         help="journal the replay run here (default: a temp file; the "
@@ -251,6 +262,7 @@ def main(argv=None) -> int:
                 devices=args.devices,
                 slo_scale=args.slo_scale,
                 journal_path=args.journal_out,
+                controller=args.controller,
             ),
         )
         if args.json:
@@ -259,6 +271,12 @@ def main(argv=None) -> int:
             print(f"Replay: {report.summary()}")
             for line in report.class_lines():
                 print(line)
+            if report.controller_state is not None:
+                st = report.controller_state
+                print(
+                    f"Replay controller: mode={st['mode']} "
+                    f"level={st['level']} actions={st['actions'] or 'none'}"
+                )
         if report.diverged:
             print(
                 "replay divergence: a neutral replay must reproduce the "

@@ -58,6 +58,11 @@ _PIDS = {
     # lane — stitched beside each backend's serve lane when the shared
     # journal DIRECTORY is exported.
     "router": 9,
+    # Autopilot lane (ISSUE 18, serving.controller): every closed-loop
+    # action/reversal/refusal renders as its own slice with the full
+    # triggering evidence as args — the timeline shows WHY the serve
+    # lane's behavior changed mid-run.
+    "controller": 10,
 }
 _KIND_PID = {
     "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
@@ -100,6 +105,12 @@ _KIND_PID = {
     # config header. Old journals without them export unchanged.
     "router_config": "router", "router_route": "router",
     "router_redirect": "router", "router_backend_state": "router",
+    # Autopilot records (ISSUE 18, docs/SERVING.md "Autopilot"): one
+    # controller_action per ladder transition (escalation, reversal, or
+    # journaled refusal), its actuation wall ms as the slice duration
+    # and its evidence (signals + thresholds + hysteresis state) riding
+    # as args. Old journals without them export unchanged.
+    "controller_action": "controller",
     "gate_pass": "tune", "gate_fail": "tune",
     "step": "train", "ckpt": "train", "rollback": "train", "resume": "train",
     "wedge_detected": "journal", "recycle": "journal", "reprobe": "journal",
@@ -122,6 +133,9 @@ _KIND_DUR_FIELD = {
     "compile_event": "ms",
     # A routed request's full router-side wall (receive -> response).
     "router_route": "ms",
+    # A controller action's actuation wall (screen + rebuild + re-warm
+    # for the dtype rung; near-zero for a policy swap).
+    "controller_action": "ms",
 }
 # Gauge-bearing record kinds -> the numeric fields that become counter
 # series. Each record emits one "C" (counter) event per listed field, so

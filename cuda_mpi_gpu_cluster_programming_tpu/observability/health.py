@@ -815,6 +815,64 @@ def slo_attainment(records: List[dict]) -> List[ClassHealth]:
 
 
 # --------------------------------------------------------------------------
+# autopilot action attribution (ISSUE 18, serving.controller)
+
+
+def controller_summary(records: List[dict]) -> dict:
+    """Fold ``controller_action`` records into a did-it-help view: action
+    counts by kind (escalations, reversals, refusals), plus the per-class
+    error-budget burn split at the FIRST actuated action — burn over the
+    outcomes journaled before the controller touched anything vs. burn
+    after. Serve records carry no timestamps; journal append order is
+    the temporal axis (the same convention the incident folder uses), so
+    "after" is everything from that action's append position on. The
+    ``serve_config`` header (the SLO budgets both halves are priced
+    against) is re-prepended to the after-slice. Empty dict when the
+    journal has no controller records — old journals fold unchanged."""
+    actions = [
+        (i, r)
+        for i, r in enumerate(records)
+        if r.get("kind") == "controller_action"
+    ]
+    if not actions:
+        return {}
+    by_kind: Dict[str, int] = {}
+    refused = reversals = 0
+    for _, r in actions:
+        kind = str(r.get("action") or "?")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        if not r.get("actuated", True):
+            refused += 1
+        elif r.get("reversal"):
+            reversals += 1
+    out: dict = {
+        "actions": by_kind,
+        "total": len(actions),
+        "refused": refused,
+        "reversals": reversals,
+    }
+    first = next(
+        (i for i, r in actions if r.get("actuated", True)), None
+    )
+    if first is not None:
+        header = [
+            r for r in records[:first] if r.get("kind") == "serve_config"
+        ]
+
+        def burns(rs: List[dict]) -> Dict[str, Optional[float]]:
+            return {
+                c.name: (
+                    round(c.burn, 3) if c.burn is not None else None
+                )
+                for c in slo_attainment(rs)
+            }
+
+        out["burn_before"] = burns(records[:first])
+        out["burn_after"] = burns(header + records[first:])
+    return out
+
+
+# --------------------------------------------------------------------------
 # compile-cost attribution & the roofline cross-check
 
 
@@ -951,6 +1009,11 @@ class HealthReport:
     probation_passes: int
     compile: dict
     n_records: int
+    # Autopilot fold (controller_summary): action counts + the
+    # before/after burn split. Empty for journals without controller
+    # records — and then absent from to_obj(), so pre-ISSUE-18 journals
+    # produce byte-identical report objects.
+    controller: dict = dataclasses.field(default_factory=dict)
 
     @property
     def trips(self) -> List[Incident]:
@@ -1001,6 +1064,7 @@ class HealthReport:
             ),
             "budget_blown": self.budget_blown,
             "compile": self.compile,
+            **({"controller": self.controller} if self.controller else {}),
         }
 
     def summary_line(self) -> str:
@@ -1053,6 +1117,28 @@ class HealthReport:
             )
             for c in self.classes:
                 lines.append(f"  {c.render()}")
+        if self.controller:
+            ctl = self.controller
+            acts = ",".join(
+                f"{k}={v}" for k, v in sorted(ctl["actions"].items())
+            )
+            lines.append(
+                f"Autopilot: {ctl['total']} action(s) "
+                f"({acts}); refused={ctl['refused']} "
+                f"reversals={ctl['reversals']}"
+            )
+            if "burn_after" in ctl:
+                for name in sorted(
+                    set(ctl.get("burn_before") or {})
+                    | set(ctl["burn_after"])
+                ):
+                    b0 = (ctl.get("burn_before") or {}).get(name)
+                    b1 = ctl["burn_after"].get(name)
+                    fmt = lambda v: f"{v:.2f}x" if v is not None else "n/a"
+                    lines.append(
+                        f"  burn {name or '(default)'}: "
+                        f"{fmt(b0)} before first action -> {fmt(b1)} after"
+                    )
         comp = self.compile
         if comp.get("unattributed"):
             lines.append(
@@ -1146,6 +1232,7 @@ def health_from_records(records: List[dict]) -> HealthReport:
         ),
         compile=compile_attribution(records),
         n_records=len(records),
+        controller=controller_summary(records),
     )
 
 

@@ -288,13 +288,27 @@ class ReplayKnobs:
     journal_path: str = ""  # replay's own journal (default: temp file)
     wait_timeout_s: float = 120.0
     percentile_floor_ms: float = 50.0
+    # Autopilot A/B dial (ISSUE 18, docs/SERVING.md "Autopilot"): "" =
+    # as recorded, "on" = force a controller onto the replay server (the
+    # recorded one when the journal carried its config, defaults
+    # otherwise), "off" = strip it. Re-driving one saturating trace both
+    # ways is the controller's proof: interactive burn must drop with it
+    # on, books must close both ways.
+    controller: str = ""
+    # Optional ControllerConfig.to_obj() dict for the forced-on side —
+    # short CI drills need snappier dwell/cooldown than the production
+    # defaults. Ignored unless ``controller == "on"``.
+    controller_cfg: Optional[dict] = None
 
     @property
     def neutral(self) -> bool:
+        # Forcing a controller ON is a what-if (the question being
+        # asked); "" and "off" leave an uncontrolled recording untouched.
         return (
             self.traffic_mult == 1.0
             and self.devices is None
             and self.slo_scale == 1.0
+            and self.controller != "on"
         )
 
 
@@ -340,6 +354,10 @@ class ReplayReport:
     cache_misses: int
     journal_path: str
     trace_id: str = ""
+    # Whether the replay server ran an autopilot, and what it did
+    # (serving.controller state_obj) — the A/B's on-side summary.
+    controller_active: bool = False
+    controller_state: Optional[dict] = None
 
     # -- accounting ---------------------------------------------------------
 
@@ -413,6 +431,13 @@ class ReplayReport:
         question being asked."""
         if not self.knobs.neutral:
             return False
+        if self.controller_active or self.recorded.config.get("controller"):
+            # A closed-loop controller actuates on wall-clock signals
+            # (burn windows, queue waits) — its actions are not part of
+            # the recorded schedule, so the determinism contract only
+            # binds controller-free pairs. The A/B's assertable half is
+            # accounting_closed + the burn comparison, not identity.
+            return False
         if not self.accounting_matches:
             return True
         return self.scripted_faults == 0 and not self.percentiles_within_resolution
@@ -436,6 +461,7 @@ class ReplayReport:
             f"mult={self.knobs.traffic_mult:g} "
             f"devices={self.knobs.devices if self.knobs.devices is not None else 'recorded'} "
             f"slo_scale={self.knobs.slo_scale:g} "
+            f"controller={'on' if self.controller_active else 'off'} "
             f"accounting_matches={self.accounting_matches} "
             f"closed={self.accounting_closed} "
             f"p50_ms={fmt(rep50)}/{fmt(rec50)} p99_ms={fmt(rep99)}/{fmt(rec99)} "
@@ -491,6 +517,8 @@ class ReplayReport:
             "cache_misses": self.cache_misses,
             "journal": self.journal_path,
             "trace_id": self.trace_id,
+            "controller": self.controller_active,
+            "controller_state": self.controller_state,
             "diverged": self.diverged,
         }
 
@@ -520,6 +548,21 @@ def _build_server(recorded: RecordedRun, knobs: ReplayKnobs):
         slo = SLOPolicy.from_obj(cfg["slo"])
         if knobs.slo_scale != 1.0:
             slo = slo.scaled(knobs.slo_scale)
+    controller = None
+    if knobs.controller != "off":
+        # "" = as recorded; "on" forces one (rebuilding the recorded
+        # knobs when the journal carried them, defaults otherwise).
+        cobj = cfg.get("controller")
+        if knobs.controller == "on" and knobs.controller_cfg:
+            cobj = knobs.controller_cfg
+        if knobs.controller == "on" or cobj:
+            from ..serving.controller import ControllerConfig
+
+            controller = (
+                ControllerConfig.from_obj(cobj)
+                if isinstance(cobj, dict)
+                else ControllerConfig()
+            )
     scfg = ServeConfig(
         config=str(cfg.get("config", "v1_jit")),
         n_shards=(
@@ -542,6 +585,7 @@ def _build_server(recorded: RecordedRun, knobs: ReplayKnobs):
         ),
         model_cfg=model_cfg,
         slo=slo,
+        controller=controller,
     )
     return InferenceServer(scfg)
 
@@ -698,6 +742,12 @@ def replay_recorded(
         cache_misses=server.stats.cache_misses,
         journal_path=knobs.journal_path,
         trace_id=tracer.trace_id if tracer is not None else "",
+        controller_active=server.controller is not None,
+        controller_state=(
+            server.controller.state_obj()
+            if server.controller is not None
+            else None
+        ),
     )
 
 

@@ -942,6 +942,44 @@ class Supervisor:
             f"SDC({e.kind}): {e.detail}"[:200], (params, opt_state),
         )
 
+    @off_timed_path
+    def request_degrade(self, cause: str) -> bool:
+        """A VOLUNTARY one-rung degrade — capacity decision, not fault
+        response (the serving autopilot's load-pressure rung,
+        docs/SERVING.md "Autopilot"). Same walk as a trip: ``_advance``
+        journals ``sup_degrade`` (cause ``"requested: ..."``), builds the
+        rung eagerly, and fires ``on_rebuild`` so the serving layer
+        re-warms before the next dispatch. The grow-back floor is pinned
+        at the CURRENT alive count afterwards, so ``maybe_promote``
+        cannot flap straight back on an unchanged pool — climbing again
+        is the caller's explicit :meth:`request_promote`. False when the
+        ladder is already at (or degrades through to) the floor."""
+        if self._idx + 1 >= len(self.ladder):
+            return False
+        try:
+            self._advance(
+                f"requested: {cause}"[:200], RuntimeError(cause)
+            )
+        except DegradationExhausted:
+            return False
+        self._promote_floor_alive = self.pool.n_alive
+        return True
+
+    @off_timed_path
+    def request_promote(self, params, opt_state=None):
+        """The voluntary grow-back half: one rung UP, bypassing the
+        alive-count hysteresis floor (the capacity judgment is the
+        caller's) but keeping every safety check :meth:`promote` makes —
+        the candidate still builds over the eligible pool and still must
+        match the current rung on the sentinel input (a refusal journals
+        ``sup_promote_refused``). Returns the resharded state, or None
+        when nothing was adopted."""
+        if self._idx == 0:
+            return None
+        return self.promote(
+            params, opt_state=opt_state, target_idx=self._idx - 1
+        )
+
     # ------------------------------------------------------------ grow-back
 
     def _spot_batch(self):

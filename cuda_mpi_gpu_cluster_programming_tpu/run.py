@@ -195,6 +195,19 @@ def make_parser() -> argparse.ArgumentParser:
                    "sizes (overrides the powers-of-two/TunePlan-derived "
                    "set)")
     p.add_argument(
+        "--serve-controller",
+        action="store_true",
+        help="with --serve: run the Autopilot closed-loop controller on "
+        "the dispatch loop (docs/SERVING.md 'Autopilot') — journaled, "
+        "hysteresis-bounded degrade/restore off live error-budget burn "
+        "and queue-knee signals (shed bulk -> narrow buckets -> int8w "
+        "downshift -> supervisor degrade; reversed in LIFO order on "
+        "recovery). Pairs with --traffic-shape: the class mix's SLO "
+        "policy is the controller's signal source; without one it is "
+        "inert by design. Prints a machine-parsed 'Serve controller:' "
+        "line",
+    )
+    p.add_argument(
         "--serve-frontend",
         type=int,
         default=None,
@@ -750,6 +763,15 @@ def main(argv=None) -> int:
             ))
             slo = slo_policy(mix)
             scfg = dataclasses.replace(scfg, slo=slo)
+        if args.serve_controller:
+            from .serving.controller import ControllerConfig
+
+            scfg = dataclasses.replace(scfg, controller=ControllerConfig())
+            if scfg.slo is None:
+                print(
+                    "Serve controller: inert (no SLO policy — pair with "
+                    "--traffic-shape for the class-mix signal source)"
+                )
         server = InferenceServer(scfg, params=params, plan=plan)
         # With --trace the tracer is already installed; otherwise the
         # serve journal doubles as the span trail, so ONE file exports
@@ -839,6 +861,10 @@ def main(argv=None) -> int:
                 for c, n in sorted(frontend.http_codes.items())
             )
             print(f"Serve transport: {codes}")
+        if server.controller is not None:
+            # Machine-parsed Autopilot line: mode/level/action counts
+            # (docs/SERVING.md "Autopilot").
+            print(f"Serve controller: {server.controller.summary()}")
         if server.sup is not None:
             # Same machine-parsed supervisor line as the one-shot
             # --supervise path (harness._RE_SUPERVISOR).

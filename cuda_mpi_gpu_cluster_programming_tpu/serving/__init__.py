@@ -9,7 +9,9 @@ the in-service degradation ladder — that journals every batch
 (``server``), a load generator with Poisson AND traffic-shaped arrivals
 plus latency-percentile reporting and the saturation sweep (``loadgen``,
 ``traffic``), the HTTP network front end over the admission queue with
-its threaded client fleet (``frontend``), and the fleet tier above N of
+its threaded client fleet (``frontend``), the journaled closed-loop
+Autopilot that walks a fixed degrade/restore ladder off live burn-rate
+and queue-knee signals (``controller``), and the fleet tier above N of
 those: a deterministic crc32 router with retry-with-redirect and
 probe-driven backend hysteresis (``router``) over N real backend
 processes spawned/killed/restarted across a process boundary
@@ -18,7 +20,9 @@ processes spawned/killed/restarted across a process boundary
 Layering rule: ``queue``/``batcher``/``loadgen``/``traffic``/``slo`` are
 stdlib+numpy only (no jax import — the same rule as
 ``resilience.policy``); only ``server`` pays the backend import, at
-dispatch-build time, and ``frontend`` rides on ``server``. ``router``
+dispatch-build time, and ``frontend`` rides on ``server``.
+``controller`` is import-light too — the ToleranceGate screen and the
+shared error-budget constant are imported lazily at actuation time. ``router``
 is stdlib-ONLY (transport and policy, never compute); ``fleet``'s
 parent half is stdlib-only too — the jax import happens in the spawned
 child processes.

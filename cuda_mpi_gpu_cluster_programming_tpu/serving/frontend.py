@@ -116,25 +116,30 @@ class _Handler(BaseHTTPRequestHandler):
         fe = self.frontend
         if self.path == "/healthz":
             qs = fe.server.queue.stats()
-            self._send_json(
-                200,
-                {
-                    "status": "ok",
-                    "queue": qs.to_obj(),
-                    "buckets": list(fe.server.buckets),
-                },
-            )
+            payload = {
+                "status": "ok",
+                "queue": qs.to_obj(),
+                "buckets": list(fe.server.buckets),
+            }
+            # Autopilot state (docs/SERVING.md "Autopilot"): mode, level,
+            # active overrides, last action + age — the router's probes
+            # see degraded-but-healthy instead of inferring it from
+            # latency. Key absent on uncontrolled servers, so the probe
+            # payload keeps its pre-ISSUE-18 shape exactly.
+            if fe.server.controller is not None:
+                payload["controller"] = fe.server.controller.state_obj()
+            self._send_json(200, payload)
         elif self.path == "/stats":
             srv = fe.server
-            self._send_json(
-                200,
-                {
-                    "serve": srv.stats.summary(),
-                    "queue": srv.queue.stats().to_obj(),
-                    "http": dict(fe.http_codes),
-                    "entry": srv.sup.entry.key if srv.sup else srv.cfg.config,
-                },
-            )
+            payload = {
+                "serve": srv.stats.summary(),
+                "queue": srv.queue.stats().to_obj(),
+                "http": dict(fe.http_codes),
+                "entry": srv.sup.entry.key if srv.sup else srv.cfg.config,
+            }
+            if srv.controller is not None:
+                payload["controller"] = srv.controller.state_obj()
+            self._send_json(200, payload)
         elif self.path == "/metrics":
             # Prometheus text exposition of the process-wide registry
             # (docs/SERVING.md): counters/gauges map directly, histograms

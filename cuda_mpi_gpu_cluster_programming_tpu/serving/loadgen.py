@@ -357,3 +357,54 @@ def _mean_images(classes: List[RequestClass]) -> float:
         mean_sz = sum(s * w for s, w in zip(c.sizes, c.size_weights)) / szw
         total += (c.weight / wsum) * mean_sz
     return total
+
+
+def saturating_rate(
+    journal_path: str,
+    classes: List[RequestClass],
+    *,
+    oversubscribe: float = 1.5,
+    batch_efficiency: float = 1.0,
+    fallback_img_s: float = 600.0,
+    lo_rps: float = 150.0,
+    hi_rps: float = 4000.0,
+) -> float:
+    """Pick a saturating request rate from a capacity probe's measured
+    service throughput — the autopilot A/B's anti-flake (docs/SERVING.md
+    "Autopilot").
+
+    A FIXED saturating rate cannot survive hosts whose speed varies 3x:
+    too low and the controller-off side never burns (the A/B goes
+    vacuous), too high and BOTH sides peg at the burn cap. The peg is
+    structural, not a tuning artifact: under shed-at-cut overload every
+    SERVED request has queue wait near the shed cut, so its end-to-end
+    latency violates the SLO too — violation share goes to ~1 as soon
+    as the protected class alone saturates. The usable regime is a rate
+    whose total offered load oversubscribes capacity while the
+    protected class ALONE still fits — there, shedding the unprotected
+    classes visibly rescues the protected one (the default mix's
+    protected class is ~half the image load, so 1.5x total puts it at
+    ~0.75x capacity).
+
+    ``journal_path`` should come from a short SATURATED, SLO-free,
+    controller-free probe: with no shed path, every batch runs at the
+    service's real (max_batch) batching, so the journal's busy
+    throughput (``n_images / batch_ms`` over ``serve_batch`` records)
+    IS the capacity — ``batch_efficiency`` stays 1.0. For a CALM probe
+    (small batches under-drive the batcher) pass ~1.5. The img/s
+    estimate converts to req/s via the mix's expected images/request,
+    times ``oversubscribe``, clamped to [lo_rps, hi_rps];
+    ``fallback_img_s`` covers a journal with no batches.
+    """
+    from ..resilience.journal import Journal
+
+    imgs = 0.0
+    busy_ms = 0.0
+    for r in Journal.load(journal_path):
+        if r.get("kind") == "serve_batch" and r.get("batch_ms"):
+            imgs += float(r.get("n_images", 0))
+            busy_ms += float(r["batch_ms"])
+    busy_img_s = imgs / (busy_ms / 1000.0) if busy_ms else fallback_img_s
+    cap_img_s = batch_efficiency * busy_img_s
+    rate = oversubscribe * cap_img_s / max(_mean_images(classes), 1e-9)
+    return min(hi_rps, max(lo_rps, rate))

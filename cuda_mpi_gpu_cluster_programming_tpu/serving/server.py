@@ -89,6 +89,13 @@ class ServeConfig:
     # fallback) record — strictly off the timed path, exported as
     # Perfetto counter tracks. 0 disables.
     mem_snapshot_s: float = 1.0
+    # Optional serving.controller.ControllerConfig: the autopilot — a
+    # journaled closed-loop controller evaluated from the observation
+    # cadence that trades admission, bucket width, precision, and
+    # capacity for the protected class's SLO under pressure
+    # (docs/SERVING.md "Autopilot"). None = every knob stays fixed at
+    # build time (the pre-PR 18 behavior).
+    controller: Any = None
 
 
 @dataclasses.dataclass
@@ -149,8 +156,19 @@ class InferenceServer:
         self._seq_snapshot = 0
         self._last_snapshot = 0.0  # monotonic: first _step snapshots
         self._submit_lock = threading.Lock()  # submit() is thread-safe
+        self._compute_override: Optional[str] = None  # live dtype downshift
         self.buckets = self._resolve_buckets()
         self._batcher = Batcher(self.queue, self.buckets)
+        self.controller = None
+        if cfg.controller is not None:
+            from .controller import AutopilotController, ControllerConfig
+
+            ctl_cfg = (
+                cfg.controller
+                if isinstance(cfg.controller, ControllerConfig)
+                else ControllerConfig.from_obj(cfg.controller)
+            )
+            self.controller = AutopilotController(self, ctl_cfg)
 
     # ------------------------------------------------------------- building
 
@@ -179,6 +197,13 @@ class InferenceServer:
         from ..models.alexnet import BLOCKS12
 
         return self.cfg.model_cfg if self.cfg.model_cfg is not None else BLOCKS12
+
+    @property
+    def current_compute(self) -> str:
+        """The precision the service is running RIGHT NOW — the build
+        compute unless the autopilot has a live dtype override installed
+        (``apply_compute``)."""
+        return self._compute_override or self.cfg.compute
 
     def _build(self) -> None:
         from ..configs import REGISTRY, build_forward
@@ -237,7 +262,7 @@ class InferenceServer:
                 site="serve",
                 entry=self.cfg.config,
                 shape=xb.shape,
-                dtype=self.cfg.compute,
+                dtype=self.current_compute,
                 ms=ms,
                 cache_hit=hit,
                 n_shards=(self.cfg.n_shards if strategy != "single" else 1),
@@ -252,26 +277,35 @@ class InferenceServer:
         After this, a dispatch that compiles is a counted cache miss.
         Off the timed path by contract: warmup fences are setup cost, not
         serving latency."""
-        import jax
-
         with span("serve.warmup", buckets=list(self.buckets)):
             for bucket in self.buckets:
-                xb = self._warm_input(bucket)
-                if self.sup is not None:
-                    # compile_event journaling rides the supervisor's
-                    # per-(rung, shape) ledger inside warm().
-                    ms = self.sup.warm(self._params, xb)
-                else:
-                    t0 = time.perf_counter()
-                    jax.block_until_ready(self._fwd(self._params, xb))
-                    ms = (time.perf_counter() - t0) * 1e3
-                    self._note_compile(xb, ms, hit=bucket in self._warmed)
-                self.stats.warmup_compiles += 1
-                self._warmed.add(bucket)
-                self._journal(
-                    "serve_warm", key=f"warm:b{bucket}", bucket=bucket,
-                    ms=round(ms, 3), dtype=self.cfg.compute,
-                )
+                self._warm_bucket(bucket)
+
+    @off_timed_path
+    def _warm_bucket(self, bucket: int) -> float:
+        """Compile ONE bucket shape on the current rung/precision and
+        journal it — warmup's unit, shared with the autopilot's actuation
+        paths (bucket widening and dtype shifts re-warm through here, so
+        post-actuation dispatch stays a compile-cache hit)."""
+        import jax
+
+        xb = self._warm_input(bucket)
+        if self.sup is not None:
+            # compile_event journaling rides the supervisor's
+            # per-(rung, shape) ledger inside warm().
+            ms = self.sup.warm(self._params, xb)
+        else:
+            t0 = time.perf_counter()
+            jax.block_until_ready(self._fwd(self._params, xb))
+            ms = (time.perf_counter() - t0) * 1e3
+            self._note_compile(xb, ms, hit=bucket in self._warmed)
+        self.stats.warmup_compiles += 1
+        self._warmed.add(bucket)
+        self._journal(
+            "serve_warm", key=f"warm:b{bucket}", bucket=bucket,
+            ms=round(ms, 3), dtype=self.current_compute,
+        )
+        return ms
 
     def _rewarm(self, entry) -> None:
         """Supervisor on_rebuild hook: a degrade landed on a fresh rung, so
@@ -346,6 +380,14 @@ class InferenceServer:
             channels=m.in_channels,
             slo=cfg.slo.to_obj() if cfg.slo is not None else None,
             devices=self.sup.pool.n_alive if self.sup is not None else 1,
+            # The autopilot's knobs (None = uncontrolled): a replay
+            # rebuilds the exact controller from this, and the
+            # --controller on|off A/B overrides it (observability.replay).
+            controller=(
+                self.controller.cfg.to_obj()
+                if self.controller is not None
+                else None
+            ),
         )
 
     def stop(self, drain: bool = True, timeout_s: float = 60.0) -> None:
@@ -385,6 +427,7 @@ class InferenceServer:
         self._maybe_promote()
         self._observe_queue()
         self._observe_resources()
+        self._observe_controller()
         batch, shed = self._batcher.next_batch(self.cfg.poll_s)
         if shed:
             self._record_shed(shed)
@@ -461,6 +504,116 @@ class InferenceServer:
             self.stats.promotions += 1
             metrics_registry().counter("serve.promotions").inc()
 
+    @off_timed_path
+    def _observe_controller(self) -> None:
+        """Autopilot evaluation (docs/SERVING.md "Autopilot"), on the
+        same between-batches observation cadence as the queue/resource
+        gauges — the controller folds signals and (rarely) actuates, all
+        strictly off the dispatch timed region."""
+        if self.controller is not None:
+            self.controller.evaluate(time.monotonic())
+
+    # --------------------------------------------------- controller hooks
+    #
+    # The autopilot's actuation surface: each method swaps ONE live knob
+    # in place, reversibly, between batches. The controller journals the
+    # decision (``controller_action`` with evidence); these journal only
+    # what the equivalent build-time path already journals (warm/rewarm
+    # records), so the trail stays one vocabulary.
+
+    @off_timed_path
+    def apply_slo_policy(self, policy) -> None:
+        """Swap the queue's pop-time admission policy. The queue reads
+        ``self.slo`` per pop under its own lock, so an atomic attribute
+        swap is the whole cutover — in-flight requests see the new cuts
+        on their next pop, admitted work is never dropped retroactively."""
+        self.queue.slo = policy
+
+    @off_timed_path
+    def apply_buckets(self, buckets) -> float:
+        """Swap the active bucket set (narrow under pressure, widen on
+        recovery). Any bucket not compiled on the current rung is warmed
+        FIRST (a widen after a mid-narrow rewarm would otherwise compile
+        on the request path), then the batcher is rebuilt over the new
+        set — its dispatch seq carries over so journal keys stay unique.
+        Returns the wall ms spent warming (0 = pure resize)."""
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not buckets:
+            raise ValueError("bucket set cannot be empty")
+        ms = 0.0
+        for bucket in buckets:
+            if bucket not in self._warmed:
+                ms += self._warm_bucket(bucket)
+        seq = self._batcher._seq
+        self.buckets = buckets
+        self._batcher = Batcher(self.queue, buckets)
+        self._batcher._seq = seq
+        return ms
+
+    @off_timed_path
+    def apply_compute(self, compute: str) -> float:
+        """Rebuild the UNSUPERVISED forward at a new precision policy and
+        re-warm every bucket before the next dispatch — the autopilot's
+        dtype downshift/upshift, ToleranceGate-screened by the caller
+        (no silent adoption; the supervisor's rungs carry no dtype axis,
+        so supervised servers degrade capacity instead). Journals one
+        ``serve_rewarm`` (the same record a ladder rebuild writes) and
+        returns its wall ms."""
+        if self.sup is not None:
+            raise RuntimeError(
+                "dtype actuation is unsupervised-only — supervised "
+                "servers degrade through the ladder"
+            )
+        from ..configs import REGISTRY, build_forward
+
+        self._fwd = build_forward(
+            REGISTRY[self.cfg.config],
+            self._model_cfg(),
+            n_shards=self.cfg.n_shards,
+            compute=compute,
+            plan=self._plan,
+        )
+        self._compute_override = (
+            compute if compute != self.cfg.compute else None
+        )
+        self._warmed.clear()
+        ms = 0.0
+        for bucket in self.buckets:
+            ms += self._warm_bucket(bucket)
+        self.stats.rewarm_ms += ms
+        metrics_registry().counter("serve.rewarms").inc()
+        self._journal(
+            "serve_rewarm", key=f"rewarm:dtype:{compute}",
+            entry=self.cfg.config, buckets=list(self.buckets),
+            ms=round(ms, 3), dtype=compute, devices=1,
+        )
+        return ms
+
+    @off_timed_path
+    def request_degrade(self, cause: str) -> bool:
+        """Ask the supervisor DOWN one rung as a capacity decision (the
+        autopilot's load-pressure rung) — same degrade walk, re-warm, and
+        journal trail as a fault trip, but with a ``requested:`` cause.
+        False when unsupervised or already at the floor."""
+        if self.sup is None:
+            return False
+        return self.sup.request_degrade(cause)
+
+    @off_timed_path
+    def request_promote(self) -> bool:
+        """The explicit grow-back half: climb one rung, sentinel-verified
+        like any promotion (a refusal journals ``sup_promote_refused``
+        and leaves the rung as-is). False when nothing was adopted."""
+        if self.sup is None:
+            return False
+        state = self.sup.request_promote(self._params)
+        if state is None:
+            return False
+        self._params = state
+        self.stats.promotions += 1
+        metrics_registry().counter("serve.promotions").inc()
+        return True
+
     def _dispatch(self, batch: AssembledBatch) -> None:
         """One timed region: pad -> run -> fence. Completion (slicing,
         handle wakeups, journal append) happens off the timed path."""
@@ -513,6 +666,12 @@ class InferenceServer:
             # estimator and population as the journal-derived serve
             # percentiles, so bench (registry) and journal p99s agree.
             reg.histogram("serve.request_ms").observe(req.handle.latency_ms)
+        if self.controller is not None:
+            # Feed the autopilot's sliding burn windows from the same
+            # per-request outcomes the journal records — the live half
+            # of the PR 15 attainment fold.
+            for req in batch.requests:
+                self.controller.note_ok(req.cls, lat_ms[req.rid])
         self.stats.n_batches += 1
         self.stats.n_images += batch.n_images
         self.stats.n_ok += len(batch.requests)
@@ -568,6 +727,9 @@ class InferenceServer:
         self.stats.n_shed += len(shed)
         reg = metrics_registry()
         reg.counter("serve.shed").inc(len(shed))
+        if self.controller is not None:
+            for req in shed:
+                self.controller.note_shed(req.cls)
         for req in shed:
             reason = req.shed_reason or "deadline"
             if reason == "slo":
@@ -586,6 +748,9 @@ class InferenceServer:
         cause = f"{type(e).__name__}: {e}"[:200]
         for req in batch.requests:
             req.handle._complete(FAILED, error=cause)
+        if self.controller is not None:
+            for req in batch.requests:
+                self.controller.note_fail(req.cls)
         self.stats.n_failed += len(batch.requests)
         metrics_registry().counter("serve.failed").inc(len(batch.requests))
         self._journal(

@@ -163,3 +163,22 @@ class SLOPolicy:
             [c.scaled(factor) for c in self.classes.values()],
             default=self.default.scaled(factor),
         )
+
+    def tightened(self, name: str, shed_wait_ms: float) -> "SLOPolicy":
+        """This policy with ``name``'s pop-time shed cut replaced — the
+        autopilot's admission-tightening actuation (serving.controller).
+        Only ``shed_wait_ms`` moves: the class's SLO target and deadline
+        are product contracts the controller must never rewrite, and the
+        burn it steers by stays priced against them. A class the policy
+        does not know is added (an unbounded class gains its first
+        finite cut this way — bulk under pressure)."""
+        cur = self.class_for(name)
+        new = dataclasses.replace(
+            cur, name=name, shed_wait_ms=float(shed_wait_ms)
+        )
+        classes = [
+            new if c.name == name else c for c in self.classes.values()
+        ]
+        if name not in self.classes:
+            classes.append(new)
+        return SLOPolicy(classes, default=self.default)

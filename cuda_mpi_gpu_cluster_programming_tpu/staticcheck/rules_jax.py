@@ -324,6 +324,11 @@ _HOT_LOOP_FILES = {
     # HBM round trip per block, so a stray host sync in the wrapper
     # would sit directly inside every timed fused pass.
     "megakernel.py",
+    # The Autopilot controller (ISSUE 18): evaluated from the dispatch
+    # loop's observation cadence every tick, so an undeclared sync in
+    # evaluate() would tax every batch. Actuation (gate screen, rewarm)
+    # is host-blocking by design and rides the @off_timed_path contract.
+    "controller.py",
 }
 _HOT_LOOP_DIRS = {"observability"}
 

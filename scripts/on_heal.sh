@@ -242,6 +242,27 @@ else
     say "FLEET-HEALTH GATE FAILED (rc=$HEALTH_RC) — blown SLO error budget (rc 3) or unreadable journal (rc 2); judge it before chip time (python -m cuda_mpi_gpu_cluster_programming_tpu.observability health --journal logs/serve_smoke_${FTS}.jsonl)"
 fi
 
+say "autopilot controller smoke (calm-trace zero-action + replay A/B lower interactive burn — docs/SERVING.md 'Autopilot')"
+# The closed loop is PROVEN before chip time: BENCH_MODE=control drives
+# a calm trace through a controller-on server (any actuation there is a
+# bug — a twitchy autopilot is worse than none), then records a
+# saturating trace and re-drives it controller-off vs controller-on
+# under the same tightened SLO scale. The row must show (a) zero calm
+# actions, (b) closed per-class accounting on BOTH replays, (c) every
+# on-side action journaled with its evidence, and (d) the protected
+# class's burn STRICTLY lower with the controller on. bench.py exits 3
+# if any clause fails, 2 if the drill itself breaks.
+timeout 600 env JAX_PLATFORMS=cpu \
+    BENCH_MODE=control \
+    BENCH_CTL_JOURNAL_DIR="logs/control_smoke_${FTS}" \
+    python bench.py 2>>"$LOG" | tail -1 | tee -a "$LOG"
+CTL_RC=${PIPESTATUS[0]}
+if [ "$CTL_RC" = 0 ]; then
+    say "controller smoke OK (calm trace clean, A/B burn strictly lower with controller on, books closed both ways; journals: logs/control_smoke_${FTS}/)"
+else
+    say "CONTROLLER SMOKE FAILED (rc=$CTL_RC) — autopilot twitchy on calm load or no measurable win under saturation; fix before chip time (journals: logs/control_smoke_${FTS}/)"
+fi
+
 say "fleet-router host-loss smoke (N backend PROCESSES behind the router, SIGKILL + redirect + probation re-admission — docs/SERVING.md 'Fleet router')"
 # The process-boundary half of the device-loss story is PROVEN before
 # chip time, same policy as every drill above: BENCH_MODE=route spawns a

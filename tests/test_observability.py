@@ -355,6 +355,55 @@ def test_export_grow_back_records_on_incident_lane(tmp_path):
     assert sorted(e["ph"] for e in probations) == ["X", "i"]
 
 
+def test_export_controller_actions_on_their_own_lane(tmp_path):
+    """ISSUE 18 satellite: controller_action records render on their own
+    "controller" lane — per-action SLICES via their ms with the full
+    evidence payload in args — so an exported incident reads signal ->
+    action -> recovery beside the serve/sup lanes. Journals without them
+    (pre-ISSUE-18) export unchanged: no controller lane appears."""
+    from cuda_mpi_gpu_cluster_programming_tpu.observability.export import (
+        _PIDS,
+    )
+
+    jp = tmp_path / "j.jsonl"
+    j = Journal(jp)
+    j.append("serve_batch", key="batch:0", bucket=2, batch_ms=3.0,
+             req_lat_ms={"r1": 4.0})
+    # pre-ISSUE-18 journal: no controller lane in events or metadata
+    trace = to_trace_events(Journal.load(jp))
+    assert all(
+        e["pid"] != _PIDS["controller"] for e in trace["traceEvents"]
+    )
+    j.append(
+        "controller_action", key="ctl:1", action="tighten_admission",
+        target="bulk", actuated=True, reversal=False, level=1, ms=2.5,
+        evidence={"burn": {"interactive": 64.0}, "oldest_wait_ms": 900.0},
+    )
+    j.append(
+        "controller_action", key="ctl:2", action="relax_admission",
+        target="bulk", actuated=True, reversal=True, level=0, ms=1.0,
+        evidence={"burn": {"interactive": 0.0}, "oldest_wait_ms": 0.0},
+    )
+    trace = to_trace_events(Journal.load(jp))
+    _validate_nesting(trace)
+    acts = [e for e in trace["traceEvents"]
+            if e["name"] == "controller_action"]
+    assert len(acts) == 2
+    for ev in acts:
+        assert ev["pid"] == _PIDS["controller"]
+        assert ev["ph"] == "X"  # ms -> slice
+        assert ev["args"]["evidence"]["burn"]["interactive"] is not None
+    assert acts[0]["dur"] == pytest.approx(2.5 * 1e3)
+    assert {a["args"]["action"] for a in acts} == {
+        "tighten_admission", "relax_admission"
+    }
+    meta = {
+        e["pid"]: e["args"]["name"] for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert meta[_PIDS["controller"]] == "controller"
+
+
 def test_export_correlated_record_pins_to_span(tmp_path):
     jp = tmp_path / "j.jsonl"
     tr = Tracer(journal=Journal(jp), seed=1)

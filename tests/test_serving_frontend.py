@@ -174,8 +174,51 @@ def test_http_healthz_and_stats_expose_queue_gauges():
         assert qs["oldest_wait_ms"] > 0  # saturation visible pre-shed
         code, body = _get(fe, "/stats")
         assert code == 200 and "queue" in body and "http" in body
+        # no controller configured => no controller key: the pre-ISSUE-18
+        # probe payload shape, exactly
+        assert "controller" not in body
         code, _ = _get(fe, "/nope")
         assert code == 404
+    finally:
+        fe.stop()
+
+
+def test_http_healthz_and_stats_expose_controller_state():
+    """ISSUE 18 satellite: with the Autopilot attached, /healthz and
+    /stats carry its state snapshot (mode, level, active overrides, last
+    action + age) — the router's probes see degraded-but-healthy instead
+    of inferring it from latency."""
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.controller import (
+        ControllerConfig,
+    )
+    from cuda_mpi_gpu_cluster_programming_tpu.serving.traffic import (
+        default_class_mix,
+        slo_policy,
+    )
+
+    mix = list(default_class_mix([1, 2, 4]))
+    srv = InferenceServer(ServeConfig(
+        config="v1_jit", max_batch=4, model_cfg=CFG,
+        slo=slo_policy(mix), controller=ControllerConfig(),
+    ))
+    fe = ServingFrontend(srv).start()
+    try:
+        for path in ("/healthz", "/stats"):
+            code, body = _get(fe, path)
+            assert code == 200
+            ctl = body["controller"]
+            assert ctl["mode"] == "steady" and ctl["level"] == 0
+            assert ctl["overrides"] == [] and ctl["last_action"] is None
+        # a degraded controller is visible through the same window
+        for _ in range(srv.controller.cfg.min_completed):
+            srv.controller.note_shed("interactive")
+        srv.controller.evaluate(now=1e9)
+        code, body = _get(fe, "/healthz")
+        ctl = body["controller"]
+        assert ctl["mode"] == "degraded" and ctl["level"] == 1
+        assert ctl["overrides"][0]["action"] == "tighten_admission"
+        assert ctl["last_action"]["action"] == "tighten_admission"
+        assert "age_s" in ctl["last_action"]
     finally:
         fe.stop()
 

@@ -359,6 +359,53 @@ def test_host_sync_scope_includes_serving_dispatch_loop(tmp_path):
     assert findings_for(ok, "host-sync-in-hot-loop") == []
 
 
+def test_host_sync_scope_includes_controller(tmp_path):
+    """ISSUE 18 satellite: the Autopilot controller is evaluated from the
+    dispatch loop's observation cadence every tick, so
+    serving/controller.py joins the hot-loop scope — the shipped module
+    stays clean (actuation rides @off_timed_path), a sync in an
+    undecorated controller loop is flagged, and the decorated copy is
+    exempt."""
+    from cuda_mpi_gpu_cluster_programming_tpu.staticcheck.rules_jax import (
+        HostSyncInHotLoopRule,
+        _HOT_LOOP_FILES,
+    )
+
+    assert "controller.py" in _HOT_LOOP_FILES
+    rule = HostSyncInHotLoopRule()
+    assert rule.applies(
+        Path("cuda_mpi_gpu_cluster_programming_tpu/serving/controller.py")
+    )
+    assert findings_for(
+        ROOT / "cuda_mpi_gpu_cluster_programming_tpu/serving/controller.py",
+        "host-sync-in-hot-loop",
+    ) == []
+    bad = tmp_path / "controller.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def evaluate(windows, fwd):\n"
+        "    burns = []\n"
+        "    for w in windows:\n"
+        "        burns.append(np.asarray(fwd(w)))\n"
+        "    return burns\n"
+    )
+    assert len(findings_for(bad, "host-sync-in-hot-loop")) == 1
+    (tmp_path / "ok").mkdir()
+    ok = tmp_path / "ok" / "controller.py"
+    ok.write_text(
+        "import numpy as np\n"
+        "from cuda_mpi_gpu_cluster_programming_tpu.resilience.sentinel "
+        "import off_timed_path\n"
+        "@off_timed_path\n"
+        "def screen(windows):\n"
+        "    burns = []\n"
+        "    for w in windows:\n"
+        "        burns.append(np.asarray(w))\n"
+        "    return burns\n"
+    )
+    assert findings_for(ok, "host-sync-in-hot-loop") == []
+
+
 def test_key_reuse_split_and_branches_ok(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(
