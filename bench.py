@@ -83,12 +83,22 @@ SERVE_METRIC = "alexnet_blocks12_serve_images_per_sec"
 # (--controller off|on) over it — accounting closed both ways, actions
 # journaled with evidence on the on side, protected-class burn strictly
 # lower with the controller on; exit 3 on any failed clause.
+# "fleetcontrol" = the fleet control plane acceptance drill (docs/
+# SERVING.md "Fleet control plane"): N controlled backend PROCESSES
+# behind the router, a calm window that must journal zero fleet
+# actions, then the SAME correlated diurnal swell (chaos
+# fleet_pressure) driven twice — fleet controller ON, then OFF
+# (N uncoordinated Autopilots). ON must keep max-simultaneously-
+# degraded below N while OFF all-degrades, with strictly lower
+# protected-class burn and accounting closed both ways; exit 3 on
+# any failed clause.
 MODE = os.environ.get("BENCH_MODE", "measure")
 SATURATE_METRIC = "alexnet_blocks12_serve_saturation"
 REPLAY_METRIC = "alexnet_blocks12_serve_replay"
 GATE_METRIC = "alexnet_blocks12_bench_gate"
 ROUTE_METRIC = "alexnet_blocks12_route_host_loss"
 CONTROL_METRIC = "alexnet_blocks12_serve_autopilot"
+FLEETCONTROL_METRIC = "alexnet_blocks12_fleet_control"
 
 CONFIG = os.environ.get("BENCH_CONFIG", "v1_jit")
 # Opt-in sweep: one JSON row per listed config (the V1->V5 story); unset =
@@ -1558,6 +1568,363 @@ def _route_main() -> int:
         return fail(f"{type(e).__name__}: {e}"[:200], platform)
 
 
+def _fleetcontrol_main() -> int:
+    """BENCH_MODE=fleetcontrol: the fleet control plane acceptance drill
+    (ISSUE 20, docs/SERVING.md "Fleet control plane") — ONE JSON row and
+    a gate exit. N controlled backend PROCESSES behind the router, four
+    journaled phases:
+
+    1. CAPACITY — a single uncontrolled backend takes a short saturated
+       HTTP burst; ``saturating_rate`` (oversubscribe=1.0) reads its
+       real per-backend service rate so the swell below is sized against
+       THIS host, not a constant that flakes on 3x-speed-spread CI.
+    2. CALM, fleet ON — steady load far below capacity: the
+       FleetController must journal ZERO fleet actions.
+    3. PRESSURE, fleet ON — chaos ``fleet_pressure`` swaps the load for
+       a correlated diurnal swell (base 0.65x fleet capacity, crest
+       ~1.24x): forecast pre-shedding + staggered downshift tokens +
+       drain-vs-shed must keep max-simultaneously-degraded below N.
+    4. PRESSURE, fleet OFF — a FRESH fleet, the SAME swell/seed with N
+       uncoordinated Autopilots: the all-degrade failure mode (max
+       simultaneously degraded == N) the plane exists to prevent.
+
+    Acceptance (each named in ``failures``, exit 3 on any): calm journals
+    zero fleet actions; ON max-degraded < N while OFF == N; protected-
+    class fleet-wide burn strictly lower ON than OFF; the router closes
+    per-class accounting both ways. Degraded-ness is read from the
+    journaled ``router_probe`` scrape trail (health.fleet_summary), not
+    from in-process state — the evidence IS the journal.
+
+    Tunables (env): BENCH_FLEETCTL_N (3), BENCH_FLEETCTL_DURATION (8 s
+    swell period == window), BENCH_FLEETCTL_CALM_RATE (6 req/s),
+    BENCH_FLEETCTL_CALM_DURATION (1.0 s), BENCH_FLEETCTL_CAP_RPS
+    (default: adaptive probe; set an absolute per-FLEET req/s to skip
+    it), BENCH_FLEETCTL_SLO_SCALE (0.2 — tightens the children's class
+    budgets so the swell burns at CI scale, both sides equally),
+    BENCH_FLEETCTL_WORKERS (64 client threads — the closed-loop depth
+    that lets the crest actually queue),
+    BENCH_FLEETCTL_HEIGHT/WIDTH (63), BENCH_FLEETCTL_MAX_BATCH (4),
+    BENCH_FLEETCTL_SEED (0), BENCH_FLEETCTL_JOURNAL (tempdir),
+    BENCH_FLEETCTL_CHAOS (seed=<seed>,fleet_pressure=1; "" drives the
+    swell directly without the chaos site). Always one JSON line.
+    """
+    import tempfile
+
+    from cuda_mpi_gpu_cluster_programming_tpu.utils.probe import probe
+
+    def fail(msg: str, platform: str = "unknown") -> int:
+        row = _error_obj(msg, platform)
+        row["metric"] = FLEETCONTROL_METRIC
+        print(json.dumps(row))
+        return 2
+
+    ok, info = probe(PROBE_TIMEOUT)
+    if not ok:
+        return fail(f"device {info}")
+    platform = info
+    try:
+        from pathlib import Path
+
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.export import (
+            load_records,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.observability.health import (
+            fleet_summary,
+            health_from_journal,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.resilience import chaos
+        from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
+            RetryPolicy,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.batcher import (
+            power_of_two_buckets,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.controller import (
+            ControllerConfig,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.fleet import (
+            BackendFleet,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.fleet_controller import (
+            FleetControllerConfig,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.frontend import (
+            http_fleet_load,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.loadgen import (
+            correlated_pressure,
+            maybe_fleet_pressure,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.router import (
+            FleetRouter,
+            RouterConfig,
+        )
+        from cuda_mpi_gpu_cluster_programming_tpu.serving.traffic import (
+            default_class_mix,
+        )
+
+        n = int(os.environ.get("BENCH_FLEETCTL_N", "3"))
+        duration = float(os.environ.get("BENCH_FLEETCTL_DURATION", "8"))
+        calm_rate = float(os.environ.get("BENCH_FLEETCTL_CALM_RATE", "6"))
+        calm_s = float(os.environ.get("BENCH_FLEETCTL_CALM_DURATION", "1.0"))
+        height = int(os.environ.get("BENCH_FLEETCTL_HEIGHT", "63"))
+        width = int(os.environ.get("BENCH_FLEETCTL_WIDTH", "63"))
+        max_batch = int(os.environ.get("BENCH_FLEETCTL_MAX_BATCH", "4"))
+        seed = int(os.environ.get("BENCH_FLEETCTL_SEED", "0"))
+        # Children run with every latency budget + deadline scaled down
+        # (BackendFleet slo_scale -> SLOPolicy.scaled, the replay what-if
+        # dial live): a CI-sized swell must burn measurably, not hide
+        # under second-scale budgets sized for production hosts.
+        slo_scale = float(os.environ.get("BENCH_FLEETCTL_SLO_SCALE", "0.2"))
+        n_workers = int(os.environ.get("BENCH_FLEETCTL_WORKERS", "64"))
+        out_dir = Path(
+            os.environ.get("BENCH_FLEETCTL_JOURNAL")
+            or tempfile.mkdtemp(prefix="fleetctl_bench_")
+        )
+        out_dir.mkdir(parents=True, exist_ok=True)
+        mix = list(default_class_mix(power_of_two_buckets(max_batch)))
+        img_shape = (height, width, 3)
+        # The same CI-cadence Autopilot on every backend, BOTH sides —
+        # the A/B isolates the fleet tier, not the per-host controller.
+        ctl_cfg = ControllerConfig(
+            eval_s=0.05, cooldown_s=0.2, min_dwell_s=0.3, min_completed=10
+        )
+        failures = []
+
+        # Arm the fleet_pressure site in THIS process only (BackendFleet
+        # pops CHAOS_SPEC from child envs): ONE draw shapes the swell,
+        # and the OFF side re-drives the identical spec string.
+        spec = os.environ.get(
+            "BENCH_FLEETCTL_CHAOS", f"seed={seed},fleet_pressure=1"
+        )
+        prev_spec = os.environ.get(chaos.CHAOS_ENV)
+        if spec:
+            os.environ[chaos.CHAOS_ENV] = spec
+        chaos.reset()
+        try:
+            # Phase 1: fleet capacity as REALIZED completed-request
+            # throughput through the FULL serving path — N uncontrolled
+            # backends behind a plain router, saturated with the swell's
+            # own client concurrency. Anything narrower (the batcher's
+            # service rate, a direct-to-backend burst) overstates what
+            # this stack delivers by integer factors, and a crest sized
+            # off it either never oversubscribes or drowns everything —
+            # both sides of the A/B prove nothing.
+            env_cap = os.environ.get("BENCH_FLEETCTL_CAP_RPS", "")
+            if env_cap:
+                cap_rps = float(env_cap)
+            else:
+                probe_dir = out_dir / "probe"
+                pfleet = BackendFleet(
+                    n, probe_dir, height=height, width=width,
+                    max_batch=max_batch, slo=False,
+                )
+                prouter = None
+                try:
+                    pfleet.start()
+                    prouter = FleetRouter(
+                        pfleet.urls(),
+                        RouterConfig(
+                            probe_interval_s=0.1,
+                            probe_timeout_s=2.0,
+                            fail_k=2,
+                            readmit_m=2,
+                            retry=RetryPolicy(
+                                max_retries=3, base_delay_s=0.02,
+                                max_delay_s=0.25, jitter=0.1,
+                            ),
+                            default_deadline_s=30.0,
+                            journal_path=str(probe_dir / "router.jsonl"),
+                        ),
+                    ).start()
+                    prep = http_fleet_load(
+                        prouter.url, img_shape, shape="steady",
+                        rate_rps=2500.0, duration_s=0.5, classes=mix,
+                        seed=seed, n_workers=n_workers,
+                    )
+                finally:
+                    if prouter is not None:
+                        prouter.stop()
+                    pfleet.stop()
+                if not prep.n_ok or prep.duration_s <= 0:
+                    return fail("capacity probe completed nothing", platform)
+                cap_rps = prep.n_ok / prep.duration_s
+            # 0.65x: crest = 0.65*(1+0.9) = 1.24x capacity — decisively
+            # oversubscribed (the OFF side must all-degrade) but with
+            # enough margin that the ON side's admitted interactive share
+            # stays under capacity THROUGH the crest even when the probe's
+            # capacity estimate wobbles with machine load.
+            base_rate = 0.65 * cap_rps
+
+            fleet_cfg = FleetControllerConfig(
+                eval_s=0.1,
+                max_concurrent_degraded=1,
+                token_cooldown_s=0.5,
+                drain_burn_high=1.0,
+                drain_after_s=0.5,
+                drain_min_s=0.5,
+                max_drained=1,
+                min_active=max(1, n - 1),
+                forecast=True,
+                forecast_period_s=duration,
+                # Preshed EARLY: the plane cannot walk an Autopilot back
+                # up its ladder, so the third backend tripping is already
+                # a lost drill — act well before realized saturation.
+                forecast_horizon_s=1.5,
+                forecast_capacity_rps=cap_rps,
+                forecast_min_samples=6,
+                forecast_burn_high=0.7,
+                forecast_burn_low=0.55,
+            )
+
+            def run_side(tag: str, fleet_on: bool, shape):
+                """One fleet lifecycle: calm window, then the swell.
+                Returns (calm_fleet_actions, pressure_report,
+                router_report, fleet_state)."""
+                side_dir = out_dir / tag
+                fleet = BackendFleet(
+                    n, side_dir, height=height, width=width,
+                    max_batch=max_batch, slo_scale=slo_scale,
+                    controller=ctl_cfg,
+                )
+                router = None
+                try:
+                    fleet.start()
+                    router = FleetRouter(
+                        fleet.urls(),
+                        RouterConfig(
+                            probe_interval_s=0.1,
+                            probe_timeout_s=2.0,
+                            fail_k=2,
+                            readmit_m=2,
+                            retry=RetryPolicy(
+                                max_retries=3, base_delay_s=0.02,
+                                max_delay_s=0.25, jitter=0.1,
+                            ),
+                            default_deadline_s=30.0,
+                            journal_path=str(side_dir / "router.jsonl"),
+                            fleet=fleet_cfg if fleet_on else None,
+                        ),
+                    ).start()
+                    http_fleet_load(
+                        router.url, img_shape, shape="steady",
+                        rate_rps=calm_rate, duration_s=calm_s,
+                        classes=mix, seed=seed,
+                    )
+                    fc = router.fleet_controller
+                    calm_actions = (
+                        sum(fc.action_counts.values()) if fc else 0
+                    )
+                    swell_shape = shape
+                    if swell_shape is None:
+                        swell_shape = (
+                            maybe_fleet_pressure(base_rate, duration)
+                            if spec
+                            else None
+                        ) or correlated_pressure(duration)
+                    rep = http_fleet_load(
+                        router.url, img_shape, shape=swell_shape,
+                        rate_rps=base_rate, duration_s=duration,
+                        classes=mix, seed=seed + 1, n_workers=n_workers,
+                    )
+                    state = fc.state_obj() if fc else None
+                    return calm_actions, rep, router.report(), state, swell_shape
+                finally:
+                    if router is not None:
+                        router.stop()
+                    fleet.stop()
+
+            # Phases 2+3: fleet ON — calm must be silent, the swell must
+            # be survived with staggered (not correlated) degradation.
+            calm_actions, on_rep, on_rrep, fleet_state, shape = run_side(
+                "on", True, None
+            )
+            if calm_actions:
+                failures.append(
+                    f"calm trace journaled {calm_actions} fleet action(s)"
+                )
+            # Phase 4: fleet OFF — same swell, uncoordinated Autopilots.
+            _, off_rep, off_rrep, _, _ = run_side("off", False, shape)
+        finally:
+            if spec:
+                if prev_spec is None:
+                    os.environ.pop(chaos.CHAOS_ENV, None)
+                else:
+                    os.environ[chaos.CHAOS_ENV] = prev_spec
+            chaos.reset()
+
+        # Verdicts come from the journals, not in-process state.
+        fs_on = fleet_summary(load_records(str(out_dir / "on")))
+        fs_off = fleet_summary(load_records(str(out_dir / "off")))
+        max_deg_on = fs_on.get("max_simultaneous_degraded")
+        max_deg_off = fs_off.get("max_simultaneous_degraded")
+        if max_deg_on is None or not max_deg_on < n:
+            failures.append(
+                f"fleet ON: {max_deg_on} of {n} backends degraded "
+                "simultaneously (want < N)"
+            )
+        if max_deg_off != n:
+            failures.append(
+                f"fleet OFF: max simultaneous degraded {max_deg_off} != {n} "
+                "(uncoordinated side never all-degraded — swell too weak "
+                "to prove anything)"
+            )
+        if not fs_on.get("total"):
+            failures.append("fleet ON journaled no fleet actions under the swell")
+
+        def _burn(tag: str):
+            for c in health_from_journal(str(out_dir / tag)).classes:
+                if c.name == fleet_cfg.protected_cls:
+                    return c.burn
+            return None
+
+        burn_on, burn_off = _burn("on"), _burn("off")
+        if burn_on is None or burn_off is None or not burn_on < burn_off:
+            failures.append(
+                f"{fleet_cfg.protected_cls} fleet-wide burn not strictly "
+                f"lower with fleet control on ({burn_on} vs {burn_off})"
+            )
+        for tag, rrep in (("on", on_rrep), ("off", off_rrep)):
+            if not rrep.closed:
+                failures.append(f"fleet {tag}: router accounting open")
+
+        row = {
+            "metric": FLEETCONTROL_METRIC,
+            # Headline = what the coordinated fleet sustains through the
+            # correlated swell.
+            "value": round(on_rep.sustained_img_s, 1),
+            "unit": "img/s",
+            "ok": not failures,
+            "failures": failures,
+            "n_backends": n,
+            "calm_actions": calm_actions,
+            "fleet_actions": fs_on.get("actions", {}),
+            "fleet_refusals": fs_on.get("refusals", 0),
+            "fleet_state": fleet_state,
+            "max_degraded": {"on": max_deg_on, "off": max_deg_off},
+            "burn_protected": {"on": burn_on, "off": burn_off},
+            "protected_cls": fleet_cfg.protected_cls,
+            "off_img_s": round(off_rep.sustained_img_s, 1),
+            "capacity_rps": round(cap_rps, 1),
+            "base_rate_rps": round(base_rate, 1),
+            "slo_scale": slo_scale,
+            "shape": shape,
+            "duration_s": duration,
+            "accounting_closed": {
+                "on": on_rrep.closed, "off": off_rrep.closed
+            },
+            "drains": fs_on.get("drains", []),
+            "chaos": spec,
+            "journal_dir": str(out_dir),
+            "platform": platform,
+        }
+        row["health"] = _health_obj(str(out_dir / "on"))
+        print(json.dumps(row))
+        return 3 if failures else 0
+    except Exception as e:
+        return fail(f"{type(e).__name__}: {e}"[:300], platform)
+
+
 def _measure_once(configs=None) -> list:
     """One full probe+measure pass; returns the JSON row list to emit, one
     row per ``configs`` entry (default: the full BENCH_CONFIGS list; the
@@ -1688,6 +2055,8 @@ def main() -> int:
         return _route_main()
     if MODE == "control":
         return _control_main()
+    if MODE == "fleetcontrol":
+        return _fleetcontrol_main()
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.journal import Journal
     from cuda_mpi_gpu_cluster_programming_tpu.resilience.policy import (
         Deadline,

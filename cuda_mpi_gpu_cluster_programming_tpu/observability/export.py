@@ -63,6 +63,13 @@ _PIDS = {
     # triggering evidence as args — the timeline shows WHY the serve
     # lane's behavior changed mid-run.
     "controller": 10,
+    # Fleet control plane (ISSUE 20, serving.fleet_controller): the
+    # router's per-probe scrapes (rung/burn/depth per backend) plus
+    # every cross-backend arbitration — token grants/refusals, drains,
+    # readmits, forecast pre-shedding — on one lane, so the timeline
+    # shows WHY a backend stopped receiving traffic before it ever
+    # went unhealthy.
+    "fleet": 11,
 }
 _KIND_PID = {
     "serve_batch": "serve", "serve_shed": "serve", "serve_fail": "serve",
@@ -111,6 +118,14 @@ _KIND_PID = {
     # and its evidence (signals + thresholds + hysteresis state) riding
     # as args. Old journals without them export unchanged.
     "controller_action": "controller",
+    # Fleet control records (ISSUE 20, docs/SERVING.md "Fleet control
+    # plane"): one fleet_action per arbitration (its actuation ms as
+    # the slice), fleet_refusal/router_probe as instants with the full
+    # fleet evidence as args. Old journals without them export
+    # unchanged (the lane's process meta only emits when it has
+    # events).
+    "fleet_action": "fleet", "fleet_refusal": "fleet",
+    "router_probe": "fleet",
     "gate_pass": "tune", "gate_fail": "tune",
     "step": "train", "ckpt": "train", "rollback": "train", "resume": "train",
     "wedge_detected": "journal", "recycle": "journal", "reprobe": "journal",
@@ -136,6 +151,10 @@ _KIND_DUR_FIELD = {
     # A controller action's actuation wall (screen + rebuild + re-warm
     # for the dtype rung; near-zero for a policy swap).
     "controller_action": "ms",
+    # A fleet action's actuation wall (a drain/preshed flag flip —
+    # near-zero, but the slice keeps the action/refusal vocabulary
+    # uniform with the controller lane).
+    "fleet_action": "ms",
 }
 # Gauge-bearing record kinds -> the numeric fields that become counter
 # series. Each record emits one "C" (counter) event per listed field, so
@@ -143,7 +162,11 @@ _KIND_DUR_FIELD = {
 # counter tracks beside the slices (the Chrome trace-event counter
 # phase). Records missing a field simply skip that series.
 _COUNTER_KINDS = {
-    "serve_gauges": ("depth", "pending_images", "oldest_wait_ms"),
+    # ctl_level (ISSUE 20) rides the gauge record on controlled servers
+    # — pre-20 records lack the field and skip the series.
+    "serve_gauges": (
+        "depth", "pending_images", "oldest_wait_ms", "ctl_level",
+    ),
     "mem_snapshot": ("bytes_in_use", "peak_bytes_in_use"),
 }
 

@@ -82,6 +82,11 @@ GROWBACK_PHASES = ("probation", "spot_check", "compile", "promote")
 # away (redirect), and the restarted backend waits out probation
 # (readmit). Summing to the outage wall by the same _clamped_phases rule.
 BACKEND_DOWN_PHASES = ("detect", "drain", "redirect", "readmit")
+# Fleet-drain incidents (ISSUE 20, serving.fleet_controller): sustained
+# burn is observed for drain_after_s (detect), the backend sits drained
+# with home traffic spilled (drain is the remainder phase), and the LIFO
+# readmit flips it back (readmit). Same _clamped_phases sum-to-wall rule.
+FLEET_DRAIN_PHASES = ("detect", "drain", "readmit")
 
 _DTYPE_TO_LEDGER = {
     "float32": "fp32", "fp32": "fp32",
@@ -315,6 +320,8 @@ class Incident:
             head = f"trip {self.cause} @{self.entry}"
         elif self.kind == "backend_down":
             head = f"backend_down {self.entry} ({self.cause})"
+        elif self.kind == "fleet_drain":
+            head = f"fleet_drain {self.entry} ({self.cause})"
         else:
             head = f"growback -> {self.entry}"
         return f"#{self.index} {head} wall={self.wall_ms:.1f}ms  {parts}"
@@ -872,6 +879,79 @@ def controller_summary(records: List[dict]) -> dict:
     return out
 
 
+def fleet_summary(records: List[dict]) -> dict:
+    """Fold the fleet control plane's trail (ISSUE 20): ``fleet_action``/
+    ``fleet_refusal`` counts by action, the max number of simultaneously
+    degraded backends (walked from the ``router_probe`` scrape trail —
+    per-backend last-seen ladder level, max count of nonzero levels at
+    any probe), and drain incidents folded into detect → drain → readmit
+    phases summing to the drain wall (a ``drain`` action paired with its
+    backend's next ``readmit``). Empty dict when the journal has no
+    fleet-control records — old journals fold unchanged."""
+    acts = [
+        r
+        for r in records
+        if r.get("kind") in ("fleet_action", "fleet_refusal")
+    ]
+    probes = [r for r in records if r.get("kind") == "router_probe"]
+    if not acts and not probes:
+        return {}
+    by_kind: Dict[str, int] = {}
+    refusals = 0
+    for r in acts:
+        name = str(r.get("action") or "?")
+        by_kind[name] = by_kind.get(name, 0) + 1
+        if r.get("kind") == "fleet_refusal":
+            refusals += 1
+    levels: Dict[str, int] = {}
+    max_deg = 0
+    for r in probes:
+        lvl = r.get("level")
+        levels[str(r.get("backend") or "")] = (
+            int(lvl) if isinstance(lvl, int) else 0
+        )
+        max_deg = max(max_deg, sum(1 for v in levels.values() if v > 0))
+    drains: List[Incident] = []
+    open_drain: Dict[str, dict] = {}
+    for r in acts:
+        if r.get("kind") != "fleet_action" or not r.get("actuated", True):
+            continue
+        tgt = str(r.get("target") or "")
+        if r.get("action") == "drain":
+            open_drain.setdefault(tgt, r)
+        elif r.get("action") == "readmit" and tgt in open_drain:
+            d = open_drain.pop(tgt)
+            detect = float((d.get("evidence") or {}).get("detect_ms") or 0.0)
+            t_drain = float(d.get("t_ms") or 0.0)
+            t_up = float(r.get("t_ms") or 0.0)
+            t0 = max(0.0, t_drain - detect)
+            wall = max(0.0, t_up - t0)
+            raw: Dict[str, Optional[float]] = {
+                "detect": min(detect, wall),
+                "readmit": float(r.get("ms") or 0.0),
+            }
+            drains.append(
+                Incident(
+                    kind="fleet_drain",
+                    index=len(drains) + 1,
+                    entry=tgt,
+                    cause=str(d.get("cause") or "drain"),
+                    wall_ms=wall,
+                    phases=_clamped_phases(
+                        wall, FLEET_DRAIN_PHASES, raw, "drain"
+                    ),
+                    t0_ms=t0,
+                )
+            )
+    return {
+        "actions": by_kind,
+        "total": len(acts),
+        "refusals": refusals,
+        "max_simultaneous_degraded": max_deg,
+        "drains": [d.to_obj() for d in drains],
+    }
+
+
 # --------------------------------------------------------------------------
 # compile-cost attribution & the roofline cross-check
 
@@ -1014,6 +1094,11 @@ class HealthReport:
     # records — and then absent from to_obj(), so pre-ISSUE-18 journals
     # produce byte-identical report objects.
     controller: dict = dataclasses.field(default_factory=dict)
+    # Fleet control fold (fleet_summary): action counts, max
+    # simultaneously degraded backends, drain incidents. Empty for
+    # journals without fleet records — and then absent from to_obj(),
+    # so pre-ISSUE-20 journals produce byte-identical report objects.
+    fleet: dict = dataclasses.field(default_factory=dict)
 
     @property
     def trips(self) -> List[Incident]:
@@ -1065,6 +1150,7 @@ class HealthReport:
             "budget_blown": self.budget_blown,
             "compile": self.compile,
             **({"controller": self.controller} if self.controller else {}),
+            **({"fleet": self.fleet} if self.fleet else {}),
         }
 
     def summary_line(self) -> str:
@@ -1139,6 +1225,25 @@ class HealthReport:
                         f"  burn {name or '(default)'}: "
                         f"{fmt(b0)} before first action -> {fmt(b1)} after"
                     )
+        if self.fleet:
+            fl = self.fleet
+            acts = ",".join(
+                f"{k}={v}" for k, v in sorted(fl["actions"].items())
+            ) or "none"
+            lines.append(
+                f"Fleet control: {fl['total']} action(s) ({acts}); "
+                f"refusals={fl['refusals']} "
+                f"max_degraded={fl['max_simultaneous_degraded']}"
+            )
+            for d in fl["drains"]:
+                parts = " ".join(
+                    f"{k}={'unattributed' if v is None else format(v, '.1f')}"
+                    for k, v in d["phases"].items()
+                )
+                lines.append(
+                    f"  drain {d['entry']} ({d['cause']}) "
+                    f"wall={d['wall_ms']:.1f}ms  {parts}"
+                )
         comp = self.compile
         if comp.get("unattributed"):
             lines.append(
@@ -1233,6 +1338,7 @@ def health_from_records(records: List[dict]) -> HealthReport:
         compile=compile_attribution(records),
         n_records=len(records),
         controller=controller_summary(records),
+        fleet=fleet_summary(records),
     )
 
 

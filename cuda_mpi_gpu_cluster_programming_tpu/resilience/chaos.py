@@ -57,6 +57,14 @@ fires would report "recovery path exercised" without exercising anything):
                       budget, and re-admit the restarted backend only
                       through probation — the process-boundary half of the
                       device_loss story.
+    fleet_pressure    serving.loadgen.maybe_fleet_pressure (fleet control
+                      tier) — swap the drill's load for a correlated
+                      diurnal swell that saturates EVERY backend at once
+                      (the failure mode N uncoordinated Autopilots
+                      all-degrade under). The FleetController must keep
+                      max-simultaneously-degraded below the fleet size
+                      via staggered downshift tokens + forecast
+                      pre-shedding, with accounting closed both ways.
     kernel_compile    run CLI build step (pallas tier) — Mosaic lowering
                       failure; degrades Pallas -> XLA reference tier.
     subprocess_wedge  harness.run_case — the classic wedged-tunnel capture
@@ -99,6 +107,7 @@ KNOWN_SITES = (
     "device_rejoin",
     "flap",
     "host_loss",
+    "fleet_pressure",
 )
 
 

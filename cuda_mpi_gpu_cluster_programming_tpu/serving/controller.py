@@ -169,6 +169,16 @@ class AutopilotController:
         self._last_action: Optional[dict] = None
         self._seq = 0
         self.action_counts: Dict[str, int] = {}
+        # Fleet intent (ISSUE 20): the last evaluated signal set is
+        # cached so /healthz can publish the controller's own verdict
+        # (overloaded/calm + the burn it was judged on) instead of the
+        # router re-deriving it. Outcome counting is a plain counter —
+        # the note_* hot path takes no clock reads.
+        self._sig_cache: Optional[ControllerSignals] = None
+        self._sig_t: Optional[float] = None
+        self._n_outcomes = 0
+        self._n_seen_outcomes = 0
+        self._last_outcome_t: Optional[float] = None
 
     # ------------------------------------------------------------- signals
 
@@ -177,12 +187,15 @@ class AutopilotController:
         self._window(cls).append(
             1 if (slo_ms and latency_ms > slo_ms) else 0
         )
+        self._n_outcomes += 1
 
     def note_shed(self, cls: str) -> None:
         self._window(cls).append(1)
+        self._n_outcomes += 1
 
     def note_fail(self, cls: str) -> None:
         self._window(cls).append(1)
+        self._n_outcomes += 1
 
     def _window(self, cls: str) -> Deque[int]:
         w = self._win.get(cls)
@@ -301,6 +314,10 @@ class AutopilotController:
             return None
         self._last_eval = now
         sig = self.signals()
+        self._sig_cache, self._sig_t = sig, now
+        if self._n_outcomes != self._n_seen_outcomes:
+            self._n_seen_outcomes = self._n_outcomes
+            self._last_outcome_t = now
         if self._overloaded(sig):
             if not self._cooled(now):
                 return None
@@ -558,14 +575,36 @@ class AutopilotController:
                 for k in ("action", "target", "actuated", "reversal", "level")
             }
             last["age_s"] = round(now - self._last_action["t"], 3)
+        # Fleet intent (ISSUE 20): the controller's own verdict over its
+        # last evaluated signals — what the FleetController arbitrates
+        # on. None until the first evaluation (or with no SLO policy).
+        sig, sig_t = self._sig_cache, self._sig_t
+        intent = None
+        if sig is not None and sig_t is not None:
+            b = sig.burn.get(self.cfg.protected_cls)
+            intent = {
+                "overloaded": self._overloaded(sig),
+                "calm": self._calm(sig),
+                "burn": round(b, 3) if b is not None else None,
+                "depth": sig.depth,
+                "oldest_wait_ms": round(sig.oldest_wait_ms, 3),
+                "age_s": round(now - sig_t, 3),
+                "idle_s": (
+                    round(now - self._last_outcome_t, 3)
+                    if self._last_outcome_t is not None
+                    else None
+                ),
+            }
         return {
             "mode": self.mode,
             "level": self.level,
+            "rung": self._applied[-1][1] if self._applied else None,
             "overrides": [
                 {"action": a, "target": t} for _, a, t, _ in self._applied
             ],
             "last_action": last,
             "actions": dict(self.action_counts),
+            "intent": intent,
         }
 
     def summary(self) -> str:

@@ -124,8 +124,10 @@ class BackendFleet:
         max_batch: int = 4,
         config: str = "v1_jit",
         slo: bool = True,
+        slo_scale: float = 1.0,
         spawn_timeout_s: float = 240.0,
         env: Optional[Dict[str, str]] = None,
+        controller=None,
     ):
         if n < 1:
             raise ValueError("fleet needs n >= 1 backends")
@@ -133,8 +135,17 @@ class BackendFleet:
         self.journal_dir = Path(journal_dir)
         self.height, self.width = height, width
         self.max_batch, self.config, self.slo = max_batch, config, slo
+        # Scales every class latency budget + deadline in the children
+        # (SLOPolicy.scaled — the replay what-if dial, live): the fleet
+        # pressure drill tightens SLOs so a CI-sized swell burns
+        # measurably instead of hiding under second-scale budgets.
+        self.slo_scale = slo_scale
         self.spawn_timeout_s = spawn_timeout_s
         self._extra_env = dict(env or {})
+        # Optional ControllerConfig (or its to_obj dict): every child
+        # runs an Autopilot — the fleet-control drills (ISSUE 20) need N
+        # real controllers to arbitrate across.
+        self.controller = controller
         self.backends: List[Optional[BackendProc]] = [None] * n
 
     def _spawn(self, index: int) -> BackendProc:
@@ -150,6 +161,17 @@ class BackendFleet:
         ]
         if self.slo:
             cmd.append("--slo")
+            if self.slo_scale != 1.0:
+                cmd.extend(["--slo-scale", repr(self.slo_scale)])
+        if self.controller is not None:
+            import json
+
+            obj = (
+                self.controller
+                if isinstance(self.controller, dict)
+                else self.controller.to_obj()
+            )
+            cmd.extend(["--controller", json.dumps(obj)])
         env = {**os.environ, **self._extra_env}
         env["PYTHONPATH"] = (
             str(_PKG_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
@@ -254,6 +276,8 @@ def _child_main(argv: List[str]) -> int:
     ap.add_argument("--journal", default="")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--slo", action="store_true")
+    ap.add_argument("--slo-scale", type=float, default=1.0)
+    ap.add_argument("--controller", default="")
     args = ap.parse_args(argv)
 
     from ..models.alexnet import BLOCKS12
@@ -271,6 +295,13 @@ def _child_main(argv: List[str]) -> int:
         slo = slo_policy(
             default_class_mix(power_of_two_buckets(args.max_batch))
         )
+        if args.slo_scale != 1.0:
+            slo = slo.scaled(args.slo_scale)
+    controller = None
+    if args.controller:
+        import json
+
+        controller = json.loads(args.controller)
     srv = InferenceServer(
         ServeConfig(
             config=args.config,
@@ -278,6 +309,7 @@ def _child_main(argv: List[str]) -> int:
             model_cfg=model_cfg,
             journal_path=args.journal or None,
             slo=slo,
+            controller=controller,
         )
     )
     srv.start()

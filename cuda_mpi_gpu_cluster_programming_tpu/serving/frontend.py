@@ -124,8 +124,14 @@ class _Handler(BaseHTTPRequestHandler):
             # Autopilot state (docs/SERVING.md "Autopilot"): mode, level,
             # active overrides, last action + age — the router's probes
             # see degraded-but-healthy instead of inferring it from
-            # latency. Key absent on uncontrolled servers, so the probe
-            # payload keeps its pre-ISSUE-18 shape exactly.
+            # latency. Since ISSUE 20 the payload also carries the
+            # controller's "rung" (current ladder rung name) and
+            # "intent" (overloaded/calm verdict + the burn/depth/wait it
+            # was judged on, with age_s/idle_s freshness) — the fleet
+            # control plane arbitrates on the controller's OWN verdict,
+            # never a router-side re-derivation. Key absent on
+            # uncontrolled servers, so the probe payload keeps its
+            # pre-ISSUE-18 shape exactly.
             if fe.server.controller is not None:
                 payload["controller"] = fe.server.controller.state_obj()
             self._send_json(200, payload)

@@ -408,3 +408,38 @@ def saturating_rate(
     cap_img_s = batch_efficiency * busy_img_s
     rate = oversubscribe * cap_img_s / max(_mean_images(classes), 1e-9)
     return min(hi_rps, max(lo_rps, rate))
+
+
+def correlated_pressure(
+    duration_s: float, *, amp: float = 0.9, period_s: Optional[float] = None
+) -> str:
+    """The fleet-control drill's load shape (ISSUE 20): one diurnal
+    swell whose crest hits EVERY backend at once — deterministic routing
+    spreads rids uniformly, so a fleet-wide ramp is per-backend
+    correlated pressure, the exact failure mode N uncoordinated
+    Autopilots all-degrade under. With the default ``amp=0.9`` the
+    crest carries 1.9x the base rate at ``period/2`` and the trough
+    ~0.1x — callers size the base at ~0.8x fleet capacity so the crest
+    oversubscribes while the protected class alone still fits. Returns
+    a ``traffic.parse_shape`` spec string.
+    """
+    period = duration_s if period_s is None else period_s
+    return f"diurnal:amp={amp},period={period}"
+
+
+def maybe_fleet_pressure(
+    rate_rps: float, duration_s: float, *, amp: float = 0.9
+) -> Optional[str]:
+    """Chaos consumer for the seeded ``fleet_pressure`` site: when the
+    site fires, the drill's load becomes a correlated diurnal swell
+    (:func:`correlated_pressure`) over the whole window. Returns the
+    shape spec to feed ``run_shaped_load``/``http_fleet_load``, or None
+    when the site didn't fire (callers keep their calm shape). The
+    swell is deterministic per CHAOS_SPEC seed — same discipline as
+    every other site."""
+    from ..resilience import chaos
+
+    ch = chaos.active()
+    if ch is None or not ch.draw("fleet_pressure"):
+        return None
+    return correlated_pressure(duration_s, amp=amp)

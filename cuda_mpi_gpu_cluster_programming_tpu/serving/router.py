@@ -42,8 +42,22 @@ all reused from earlier subsystems rather than invented here:
   unroutable == offered`` (:class:`RouterClassStats` — the PR 11
   identity grown one bucket).
 
+When ``RouterConfig.fleet`` is set, the probe sweep also feeds a
+:class:`~.fleet_controller.FleetController` (ISSUE 20): each probe
+parses the backend's ``/healthz`` controller sub-object (ladder rung,
+protected burn, intent, queue depth) into its ``BackendSlot`` and
+journals a ``router_probe`` record; the controller arbitrates across
+backends — staggered downshift tokens, drain-vs-shed, forecast
+pre-actuation — actuating through :meth:`FleetRouter.set_drained`
+(drained backends keep probing but receive no routed traffic; their
+home load spills) and :meth:`FleetRouter.set_preshed` (listed classes
+are pre-shed at the router with 429, counted ``rejected`` on both
+ledgers so accounting stays closed).
+
 Journals: the router writes its own (``router_config`` /
-``router_route`` / ``router_redirect`` / ``router_backend_state``); each
+``router_route`` / ``router_redirect`` / ``router_backend_state`` /
+``router_probe`` and, fleet-controlled, ``fleet_action`` /
+``fleet_refusal``); each
 backend keeps writing its own. ``observability.export.load_records`` on
 the shared directory stitches all of them into one Perfetto timeline,
 and ``observability.health`` folds backend-down windows into
@@ -71,6 +85,7 @@ from ..observability.metrics import registry as metrics_registry
 from ..observability.trace import off_timed_path
 from ..resilience.journal import Journal
 from ..resilience.policy import Deadline, RetryPolicy
+from .fleet_controller import FleetController, FleetControllerConfig
 from .traffic import ClassStats, _fmt_ms
 
 # Backend states (the ElasticPool discipline, per process instead of per
@@ -113,6 +128,9 @@ class RouterConfig:
     no_spill_classes: Tuple[str, ...] = ("bulk",)
     max_wait_s: float = 120.0  # per-hop response-wait cap
     journal_path: Optional[str] = None
+    # Fleet control plane (ISSUE 20): when set, the probe sweep feeds a
+    # FleetController that arbitrates degradation across backends.
+    fleet: Optional[FleetControllerConfig] = None
 
 
 @dataclasses.dataclass
@@ -129,6 +147,17 @@ class BackendSlot:
     first_fail: Optional[float] = None  # clock of the streak's first miss
     down_since: Optional[float] = None
     probation_since: Optional[float] = None
+    # Fleet-drain flag (ISSUE 20): a drained backend keeps its health
+    # state and keeps probing, but _pick skips it — home traffic spills
+    # exactly like probation.
+    drained: bool = False
+    # Scraped controller state from the last successful probe (None on
+    # backends without an Autopilot — pre-20 /healthz payloads).
+    ctl_level: Optional[int] = None
+    ctl_mode: Optional[str] = None
+    ctl_burn: Optional[float] = None
+    ctl_overloaded: Optional[bool] = None
+    queue_depth: Optional[int] = None
 
     @property
     def host_port(self) -> Tuple[str, int]:
@@ -279,7 +308,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/stats":
-            self._send_json(200, ro.report().to_obj())
+            payload = ro.report().to_obj()
+            if ro.fleet_controller is not None:
+                payload["fleet"] = ro.fleet_controller.state_obj()
+            self._send_json(200, payload)
         else:
             self._send_json(404, {"error": f"no route {self.path!r}"})
 
@@ -369,6 +401,18 @@ class FleetRouter:
         self._thread: Optional[threading.Thread] = None
         self._probe_thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
+        # Fleet control plane (ISSUE 20): evaluated from probe_once, owns
+        # no thread. Classes in _preshed are refused 429 at the router
+        # (tuple assignment is atomic — read lock-free on the hot path).
+        self._preshed: Tuple[str, ...] = ()
+        self.fleet_controller: Optional[FleetController] = None
+        if cfg.fleet is not None:
+            fc = (
+                cfg.fleet
+                if isinstance(cfg.fleet, FleetControllerConfig)
+                else FleetControllerConfig.from_obj(dict(cfg.fleet))
+            )
+            self.fleet_controller = FleetController(self, fc)
         self._journal_append(
             "router_config",
             key="router",
@@ -382,6 +426,11 @@ class FleetRouter:
             retry=dataclasses.asdict(cfg.retry),
             no_spill_classes=list(cfg.no_spill_classes),
             t_ms=self._t_ms(),
+            **(
+                {"fleet": self.fleet_controller.cfg.to_obj()}
+                if self.fleet_controller is not None
+                else {}
+            ),
         )
 
     # ------------------------------------------------------------ lifecycle
@@ -441,10 +490,15 @@ class FleetRouter:
         for slot in self.slots:
             if slot.state == QUARANTINED:
                 continue  # sticky: a quarantined host needs an operator
-            ok, ms, why = self._probe(slot)
+            ok, ms, why, scrape = self._probe(slot)
             self._note_probe(slot, ok, ms, why)
+            self._note_scrape(slot, ok, ms, scrape)
+        if self.fleet_controller is not None:
+            self.fleet_controller.evaluate(self._clock())
 
-    def _probe(self, slot: BackendSlot) -> Tuple[bool, float, str]:
+    def _probe(
+        self, slot: BackendSlot
+    ) -> Tuple[bool, float, str, Optional[dict]]:
         host, port = slot.host_port
         t0 = time.monotonic()
         try:
@@ -460,7 +514,7 @@ class FleetRouter:
                 if resp.status != 200 or body.get("status") != "ok":
                     return False, (time.monotonic() - t0) * 1e3, (
                         f"healthz:{resp.status}"
-                    )
+                    ), None
                 # The metrics scrape rides every probe: the Prometheus
                 # surface stays exercised (and journaled backend-side as a
                 # serve_transport record) and a wedged exporter is a
@@ -471,14 +525,14 @@ class FleetRouter:
                 if m.status != 200:
                     return False, (time.monotonic() - t0) * 1e3, (
                         f"metrics:{m.status}"
-                    )
+                    ), None
             finally:
                 conn.close()
         except (OSError, http.client.HTTPException, ValueError) as e:
             return False, (time.monotonic() - t0) * 1e3, (
                 f"conn:{type(e).__name__}"
-            )
-        return True, (time.monotonic() - t0) * 1e3, ""
+            ), None
+        return True, (time.monotonic() - t0) * 1e3, "", body
 
     def _note_probe(
         self, slot: BackendSlot, ok: bool, ms: float, why: str
@@ -583,6 +637,81 @@ class FleetRouter:
             **extra,
         )
 
+    def _note_scrape(
+        self, slot: BackendSlot, ok: bool, ms: float, scrape: Optional[dict]
+    ) -> None:
+        """Fold one successful probe's scraped ``/healthz`` payload into
+        the slot (ISSUE 20): the controller sub-object (ladder rung,
+        protected burn, intent) and queue depth become the fleet
+        controller's evidence, and every scrape journals a
+        ``router_probe`` record. Backends without an Autopilot (pre-20
+        payloads) scrape to None fields — the record still carries the
+        queue depth, and old journals export unchanged."""
+        if not ok:
+            return  # the failed probe already journaled its transition
+        scrape = scrape or {}
+        q = scrape.get("queue")
+        ctl = scrape.get("controller")
+        with self._lock:
+            depth = (q or {}).get("depth")
+            slot.queue_depth = depth if isinstance(depth, int) else None
+            if isinstance(ctl, dict):
+                slot.ctl_level = int(ctl.get("level") or 0)
+                slot.ctl_mode = str(ctl.get("mode") or "") or None
+                intent = ctl.get("intent")
+                if isinstance(intent, dict):
+                    burn = intent.get("burn")
+                    slot.ctl_burn = (
+                        float(burn) if isinstance(burn, (int, float)) else None
+                    )
+                    slot.ctl_overloaded = bool(intent.get("overloaded"))
+                else:
+                    slot.ctl_burn = None
+                    slot.ctl_overloaded = None
+            else:
+                slot.ctl_level = None
+                slot.ctl_mode = None
+                slot.ctl_burn = None
+                slot.ctl_overloaded = None
+        self._journal_probe(slot, ms)
+
+    @off_timed_path
+    def _journal_probe(self, slot: BackendSlot, ms: float) -> None:
+        self._journal_append(
+            "router_probe",
+            key=f"probe:{slot.name}",
+            backend=slot.name,
+            state=slot.state,
+            drained=slot.drained,
+            level=slot.ctl_level,
+            mode=slot.ctl_mode,
+            burn=slot.ctl_burn,
+            overloaded=slot.ctl_overloaded,
+            depth=slot.queue_depth,
+            probe_ms=round(ms, 3),
+            t_ms=self._t_ms(),
+        )
+
+    def set_drained(self, index: int, drained: bool) -> None:
+        """Fleet-drain hook (ISSUE 20): a drained backend keeps its
+        health state and keeps probing but receives no routed traffic —
+        home load spills exactly like probation. The FleetController's
+        ``fleet_action`` record IS the journal entry; this just flips
+        the flag."""
+        with self._lock:
+            self.slots[index].drained = bool(drained)
+        metrics_registry().counter(
+            "router.drain" if drained else "router.drain_release"
+        ).inc()
+
+    def set_preshed(self, classes: Sequence[str]) -> None:
+        """Fleet pre-shed hook (ISSUE 20): listed classes are refused
+        429 at the router before any forwarding — counted ``rejected``
+        on both the router and client ledgers, so accounting stays
+        closed while the fleet keeps its capacity for protected
+        traffic."""
+        self._preshed = tuple(classes)
+
     def replace_backend(self, index: int, url: str) -> None:
         """Point a slot at a restarted backend's new endpoint. The slot
         keeps its position (the hash ring is stable) and its state — a
@@ -630,7 +759,11 @@ class FleetRouter:
     def _pick(self, order: List[int], avoid: Optional[int]) -> Optional[int]:
         with self._lock:
             for i in order:
-                if i != avoid and self.slots[i].state in ROUTABLE:
+                if (
+                    i != avoid
+                    and self.slots[i].state in ROUTABLE
+                    and not self.slots[i].drained
+                ):
                     return i
             # The backend that just refused may be the only routable one
             # left — backpressure clears, so retrying it beats giving up.
@@ -638,6 +771,7 @@ class FleetRouter:
                 avoid is not None
                 and avoid in order
                 and self.slots[avoid].state in ROUTABLE
+                and not self.slots[avoid].drained
             ):
                 return avoid
         return None
@@ -669,6 +803,20 @@ class FleetRouter:
         429/504/connection-failure through the candidate walk under the
         RetryPolicy's backoff, the request's resolved deadline bounding
         both pauses and hop timeouts. Every hop is journaled."""
+        if cls in self._preshed:
+            # Fleet pre-shed (ISSUE 20): refused before any forwarding,
+            # counted rejected on both ledgers (http_fleet_load maps 429
+            # to rejected) — the closed identity survives pre-actuation.
+            body_out = json.dumps(
+                {
+                    "rid": rid,
+                    "status": "REJECTED",
+                    "class": cls,
+                    "reason": "fleet_preshed",
+                    "error": "class pre-shed by fleet controller",
+                }
+            ).encode()
+            return RouteResult(429, body_out, "rejected", "", 0, 0)
         dl = Deadline.after(
             deadline_s if deadline_s is not None else self.cfg.default_deadline_s
         )

@@ -482,6 +482,15 @@ class InferenceServer:
             depth=qs.depth,
             pending_images=qs.pending_images,
             oldest_wait_ms=qs.oldest_wait_ms,
+            # Controller ladder depth rides the gauge record (ISSUE 20)
+            # so the degrade trajectory is a counter series beside the
+            # queue trio; absent without an Autopilot — pre-20 journals
+            # export unchanged.
+            **(
+                {"ctl_level": self.controller.level}
+                if self.controller is not None
+                else {}
+            ),
         )
         self._journal(
             "mem_snapshot", key=f"mem:{self._seq_snapshot}", t_ms=t_ms, **snap

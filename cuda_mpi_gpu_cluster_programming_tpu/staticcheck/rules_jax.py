@@ -329,6 +329,11 @@ _HOT_LOOP_FILES = {
     # evaluate() would tax every batch. Actuation (gate screen, rewarm)
     # is host-blocking by design and rides the @off_timed_path contract.
     "controller.py",
+    # The fleet control plane (ISSUE 20): evaluated from the router's
+    # probe sweep, whose latency IS the fleet's detection time — a
+    # stray sync there delays every backend's scrape. Journaling rides
+    # @off_timed_path like the router's own record writers.
+    "fleet_controller.py",
 }
 _HOT_LOOP_DIRS = {"observability"}
 

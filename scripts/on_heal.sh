@@ -301,6 +301,40 @@ timeout 120 python -m cuda_mpi_gpu_cluster_programming_tpu.observability \
     --out "logs/trace_route_${FTS}.json" 2>&1 | tee -a "$LOG" \
     || say "route trace export failed — see $LOG"
 
+say "fleet-control smoke (correlated 3-backend swell: staggered degrade + forecast pre-shed beat N uncoordinated Autopilots — docs/SERVING.md 'Fleet control plane')"
+# The fleet TIER of the control loop is PROVEN before chip time:
+# BENCH_MODE=fleetcontrol sizes a correlated diurnal swell (chaos
+# fleet_pressure) off this host's measured through-the-router capacity
+# and drives it twice — fleet controller ON, then OFF with the same
+# N per-host Autopilots uncoordinated. The row must show (a) a calm
+# window with ZERO fleet actions, (b) max-simultaneously-degraded < N
+# on the ON side while the OFF side all-degrades (== N — the exact
+# failure mode the plane exists to prevent), (c) the protected class's
+# fleet-wide burn STRICTLY lower with the plane on, and (d) the
+# router's per-class accounting closed on BOTH sides. bench.py exits 3
+# if any clause fails, 2 if the drill itself breaks; the assertions
+# below re-read the evidence from the row rather than trusting the rc.
+if timeout 600 env JAX_PLATFORMS=cpu \
+    BENCH_MODE=fleetcontrol \
+    BENCH_FLEETCTL_JOURNAL="logs/fleetctl_smoke_${FTS}" \
+    python bench.py 2>>"$LOG" | tail -1 | tee -a "$LOG" \
+    | python -c "
+import json, sys
+d = json.loads(sys.stdin.readlines()[-1])
+deg, acct = d.get('max_degraded') or {}, d.get('accounting_closed') or {}
+n = d.get('n_backends') or 0
+ok = (not d.get('error')
+      and d.get('ok') is True
+      and d.get('calm_actions') == 0
+      and deg.get('on') is not None and deg.get('on') < n
+      and deg.get('off') == n
+      and acct.get('on') is True and acct.get('off') is True)
+sys.exit(0 if ok else 1)"; then
+    say "fleet-control smoke OK (calm silent, staggered degrade held under the swell while uncoordinated all-degraded, protected burn strictly lower, books closed both ways; journals: logs/fleetctl_smoke_${FTS}/)"
+else
+    say "FLEET-CONTROL SMOKE FAILED — the control plane is twitchy on calm load or loses to uncoordinated Autopilots; fix before fronting chip traffic this window (journals: logs/fleetctl_smoke_${FTS}/)"
+fi
+
 say "perf-regression gate over the committed BENCH trajectory (echo-aware; a >10% surviving regression blocks the window)"
 # The gate that turns bench_report from a viewer into CI: last_good
 # echoes are excluded attributably (the r02-r05 wedge trail), and any
